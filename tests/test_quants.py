@@ -1,0 +1,88 @@
+"""Quantization tests — the reference's quants-test.cpp ported in spirit:
+roundtrip error bounds swept over sizes (quants-test.cpp:7-52), plus Q40
+packing-layout checks against hand-computed blocks."""
+
+import numpy as np
+import pytest
+
+from dllama_tpu import quants
+
+
+def test_batch_bytes():
+    # getBatchBytes semantics (quants.cpp:28-51)
+    assert quants.batch_bytes(quants.F32, 320, 2) == 320 * 2 * 4
+    assert quants.batch_bytes(quants.F16, 320, 2) == 320 * 2 * 2
+    assert quants.batch_bytes(quants.Q40, 320, 2) == (320 // 32) * 18 * 2
+    assert quants.batch_bytes(quants.Q80, 320, 2) == (320 // 32) * 34 * 2
+    with pytest.raises(ValueError):
+        quants.batch_bytes(quants.Q40, 33, 1)
+
+
+@pytest.mark.parametrize("n", [1024, 768, 2752])
+def test_q80_roundtrip_error(n):
+    # reference bound: max abs error 0.0043 on randomF32(seed)-style data
+    # (quants-test.cpp:30-38)
+    rng = np.random.RandomState(1234)
+    x = rng.rand(n).astype(np.float32)
+    raw = quants.quantize_q80(x)
+    assert raw.size == quants.batch_bytes(quants.Q80, n)
+    y = quants.dequantize_q80(raw, n)
+    assert np.abs(x - y).max() <= 0.0043
+
+
+@pytest.mark.parametrize("n", [1024, 2752])
+def test_q40_roundtrip_error(n):
+    rng = np.random.RandomState(99)
+    x = (rng.rand(n).astype(np.float32) - 0.5) * 2
+    raw = quants.quantize_q40(x)
+    assert raw.size == quants.batch_bytes(quants.Q40, n)
+    y = quants.dequantize_q40(raw, n)
+    # 4-bit: max error is half a quantization step = absmax/16 per block
+    steps = np.abs(x.reshape(-1, 32)).max(axis=1) / 8.0
+    bound = np.repeat(steps, 32) * 1.01 + 1e-6
+    assert np.all(np.abs(x - y) <= bound)
+
+
+def test_q40_block_layout():
+    # value i is the low nibble of byte i, value i+16 the high nibble
+    # (writer.py:46-52 / BlockQ40 quants.hpp:17-20)
+    x = np.zeros(32, dtype=np.float32)
+    x[0] = 8.0   # quantizes to nibble 0 (== -8 → value -8*delta)
+    x[16] = -8.0
+    raw = quants.quantize_q40(x)
+    assert raw.size == 18
+    d = raw[:2].copy().view(np.float16)[0]
+    assert float(d) == -1.0  # delta = min/-8 ... max=8, min=-8 → -min>max false → 8/-8 = -1
+    y = quants.dequantize_q40(raw, 32)
+    assert y[0] == pytest.approx(8.0, abs=0.6)
+    # writer.py clamps the +8.5-offset code at 15 (writer.py:41), so the
+    # extreme negative value loses one step: (15-8)*(-1) = -7
+    assert y[16] == pytest.approx(-7.0, abs=0.6)
+
+
+def test_q40_planes_match_dequant():
+    rng = np.random.RandomState(7)
+    d_out, n_in = 6, 64
+    w = rng.randn(d_out, n_in).astype(np.float32)
+    raw = quants.quantize_q40(w)
+    qvals, scales = quants.q40_planes(raw, (d_out, n_in))
+    assert qvals.shape == (d_out, n_in)
+    assert scales.shape == (d_out, n_in // 32)
+    recon = qvals.astype(np.float32) * np.repeat(scales, 32, axis=1)
+    ref = quants.dequantize_q40(raw, d_out * n_in).reshape(d_out, n_in)
+    np.testing.assert_allclose(recon, ref, rtol=0, atol=1e-6)
+
+
+def test_q80_zeros():
+    x = np.zeros(64, dtype=np.float32)
+    y = quants.dequantize_q80(quants.quantize_q80(x), 64)
+    assert np.all(y == 0)
+
+
+def test_tensor_roundtrip_all_types():
+    rng = np.random.RandomState(5)
+    x = rng.randn(128).astype(np.float32)
+    for ftype, tol in [(quants.F32, 0), (quants.F16, 2e-3), (quants.Q80, 0.03), (quants.Q40, 0.4)]:
+        raw = quants.quantize_tensor(x, ftype)
+        y = quants.dequantize_tensor(raw, ftype, 128)
+        assert np.abs(x - y).max() <= tol + 1e-9, f"ftype={ftype}"

@@ -1,0 +1,195 @@
+"""Tokenizer / chat-template / EOS-detector tests.
+
+Ports the reference test strategy from `src/tokenizer-test.cpp:14-176`:
+template type detection from real Jinja fragments, and the EosDetector
+streaming state machine (partial-match holdback, padding variants, delta
+extraction).  BPE encode is validated on a constructed sentencepiece-style
+vocab with byte fallback."""
+
+import pytest
+
+from dllama_tpu.io.tfile import TokenizerData
+from dllama_tpu.sampling import Sampler, xorshift_f32
+from dllama_tpu.tokenizer.bpe import Tokenizer
+from dllama_tpu.tokenizer.chat import (ChatItem, ChatTemplate, TokenizerChatStops,
+                                       detect_template_type)
+from dllama_tpu.tokenizer.eos import EOS, MAYBE_EOS, NOT_EOS, EosDetector
+
+import numpy as np
+
+
+def make_tokenizer():
+    # sentencepiece-like vocab: specials, byte pieces at ids 3..258, then words
+    vocab = [b"<unk>", b"<s>", b"</s>"]
+    vocab += [f"<0x{i:02X}>".encode() for i in range(256)]
+    words = [b" ", b"h", b"e", b"l", b"o", b"he", b"ll", b"hell", b"hello", b" hello",
+             b"w", b"r", b"d", b"wo", b"wor", b"worl", b"world", b" world"]
+    scores = [0.0] * len(vocab)
+    # longer merges get higher scores so greedy BPE prefers them
+    for wpiece in words:
+        vocab.append(wpiece)
+        scores.append(float(len(wpiece)))
+    data = TokenizerData(vocab=vocab, scores=scores, bos_id=1, eos_id=2,
+                         chat_eos_id=2, chat_template=None, chat_stop=None)
+    return Tokenizer(data)
+
+
+def test_encode_merges_to_words():
+    t = make_tokenizer()
+    ids = t.encode("hello world", add_bos=True)
+    assert ids[0] == t.bos_id
+    pieces = [t.vocab[i] for i in ids[1:]]
+    # dummy prefix " " merges with "hello"; " world" merges fully
+    assert b"".join(pieces) == b" hello world"
+    assert pieces == [b" hello", b" world"]
+
+
+def test_encode_byte_fallback():
+    t = make_tokenizer()
+    ids = t.encode("h\x07", add_bos=False)
+    # \x07 is not in vocab → byte fallback id = 7 + 3 (tokenizer.cpp:250-253)
+    assert ids[-1] == 0x07 + 3
+
+
+def test_encode_utf8_multibyte_fallback():
+    t = make_tokenizer()
+    ids = t.encode("é", add_bos=False)  # 0xC3 0xA9, not in vocab
+    assert ids[-2:] == [0xC3 + 3, 0xA9 + 3]
+
+
+def test_encode_empty_adds_only_bos():
+    t = make_tokenizer()
+    assert t.encode("", add_bos=True) == [1]
+    assert t.encode("", add_bos=False) == []
+
+
+def test_decode_strips_space_after_bos_and_bytes():
+    t = make_tokenizer()
+    ids = t.encode("hello", add_bos=True)
+    assert t.decode(ids) == "hello"
+    # byte piece decode
+    assert t.decode_piece(0, 0x41 + 3) == b"A"
+
+
+def test_encode_decode_roundtrip():
+    t = make_tokenizer()
+    for text in ["hello world", "hello", "held", "wow"]:
+        ids = t.encode(text, add_bos=True)
+        assert t.decode(ids) == text
+
+
+# --- chat templates (tokenizer-test.cpp:14-56 spirit) ---
+
+LLAMA3_JINJA = "{% set loop_messages = messages %}<|start_header_id|>..."
+ZEPHYR_JINJA = "{% for message in messages %}<|user|>..."
+CHATML_JINJA = "{% for message in messages %}<|im_start|>..."
+
+
+def test_template_detection():
+    assert detect_template_type(LLAMA3_JINJA) == "llama3"
+    assert detect_template_type(ZEPHYR_JINJA) == "zephyr"
+    assert detect_template_type(CHATML_JINJA) == "chatml"
+    with pytest.raises(ValueError):
+        detect_template_type("{{ bos_token }}{% raw %}nope{% endraw %}")
+
+
+def test_llama3_render():
+    ct = ChatTemplate(LLAMA3_JINJA, "<|eot_id|>")
+    out = ct.generate([ChatItem("system", "sys"), ChatItem("user", "hi")], True)
+    assert out == ("<|start_header_id|>system<|end_header_id|>\n\nsys<|eot_id|>"
+                   "<|start_header_id|>user<|end_header_id|>\n\nhi<|eot_id|>"
+                   "<|start_header_id|>assistant<|end_header_id|>\n\n")
+
+
+def test_chatml_render():
+    ct = ChatTemplate(CHATML_JINJA, "<|im_end|>")
+    out = ct.generate([ChatItem("user", "hi")], True)
+    assert out == "<|im_start|>user\nhi<|im_end|>\n<|im_start|>assistant\n"
+
+
+def test_zephyr_render():
+    ct = ChatTemplate(ZEPHYR_JINJA, "</s>")
+    out = ct.generate([ChatItem("user", "hi")], False)
+    assert out == "<|user|>\nhi</s>\n"
+
+
+def test_chat_stops():
+    t = make_tokenizer()
+    t.chat_eos_id = 2
+    stops = TokenizerChatStops(t)
+    assert stops.stops == ["</s>"]
+    t.chat_stop = "<|im_end|>"
+    stops = TokenizerChatStops(t)
+    assert stops.stops == ["</s>", "<|im_end|>"] and stops.max_stop_length == 10
+
+
+# --- EosDetector (tokenizer-test.cpp:58-176 spirit) ---
+
+def test_eos_token_id_is_hard_stop():
+    d = EosDetector(2, ["<eos>"])
+    assert d.append(2, "<eos>") == EOS
+    assert d.get_delta() is None
+
+
+def test_eos_string_across_pieces():
+    d = EosDetector(-1, ["<eos>"])
+    assert d.append(5, "<e") == MAYBE_EOS
+    assert d.append(6, "os>") == EOS
+    assert d.get_delta() is None
+
+
+def test_eos_with_left_padding():
+    d = EosDetector(-1, ["<eos>"], padding_left=2)
+    assert d.append(5, "x<eos>") == EOS
+    assert d.get_delta() == "x"
+
+
+def test_eos_with_right_padding():
+    d = EosDetector(-1, ["<eos>"], padding_right=2)
+    assert d.append(5, "<eos>y") == EOS
+    assert d.get_delta() is None
+
+
+def test_not_eos_flushes_text():
+    d = EosDetector(-1, ["<eos>"])
+    assert d.append(5, "hello") == NOT_EOS
+    assert d.get_delta() == "hello"
+    d.clear()
+    assert d.append(6, "<e") == MAYBE_EOS
+    assert d.append(7, "xx") == NOT_EOS
+    assert d.get_delta() == "<exx"
+
+
+def test_maybe_then_overflow_is_not_eos():
+    d = EosDetector(-1, ["<eos>"])
+    assert d.append(5, "<eo") == MAYBE_EOS
+    assert d.append(6, "zzzzzz") == NOT_EOS
+
+
+# --- Sampler ---
+
+def test_sampler_greedy():
+    s = Sampler(5, 0.0, 0.9, 1)
+    assert s.sample(np.array([0.1, 3.0, 0.2, 0.0, -1.0])) == 1
+
+
+def test_sampler_temperature_deterministic_seed():
+    logits = np.linspace(0, 2, 32).astype(np.float32)
+    a = Sampler(32, 0.8, 0.0, 12345).sample(logits.copy())
+    b = Sampler(32, 0.8, 0.0, 12345).sample(logits.copy())
+    assert a == b
+
+
+def test_sampler_topp_prunes_tail():
+    # one dominant token with topp=0.5 → always chosen regardless of coin
+    logits = np.full(16, -10.0, dtype=np.float32)
+    logits[3] = 10.0
+    for seed in range(5):
+        assert Sampler(16, 1.0, 0.5, seed).sample(logits.copy()) == 3
+
+
+def test_xorshift_range():
+    state = 12345
+    for _ in range(100):
+        state, v = xorshift_f32(state)
+        assert 0.0 <= v < 1.0
