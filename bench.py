@@ -1,0 +1,95 @@
+"""Benchmark: single-token decode throughput on real TPU hardware.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N}
+
+Mirrors the reference's benchmark mode (`dllama inference`,
+dllama.cpp:45-93): average per-token generation time over nSamples decode
+steps after prefill.  Baseline for comparison is the reference's best
+published single-node Llama-2-7B number — 101.81 ms/token (9.82 tok/s) on a
+c3d-highcpu-30 VM (README.md:126, BASELINE.md) — since multi-chip hardware
+is not reachable from this harness (one v5e chip via the axon tunnel).
+
+Weights are zero-initialized on device: dense decode timing is
+value-independent, and materializing 7B random f32 weights on host would
+need ~27 GB RAM.  Falls back to TinyLlama-1.1B shapes if the 7B working set
+does not fit the chip.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def model_cfgs():
+    from dllama_tpu.models.config import tiny_config
+    # llama-2-7b shapes (README.md:102 measurement target), short KV budget
+    llama7b = tiny_config(dim=4096, hidden_dim=11008, n_layers=32, n_heads=32,
+                          n_kv_heads=32, vocab_size=32000, seq_len=1024,
+                          dtype=jnp.bfloat16)
+    # tinyllama-1.1b (launch.py:7)
+    tiny11 = tiny_config(dim=2048, hidden_dim=5632, n_layers=22, n_heads=32,
+                         n_kv_heads=4, vocab_size=32000, seq_len=2048,
+                         dtype=jnp.bfloat16)
+    return [("llama2-7b", llama7b, 9.82), ("tinyllama-1.1b", tiny11, None)]
+
+
+def bench_decode(cfg, chunk=32, n_chunks=4):
+    """Times the production path: the on-device K-step generation loop
+    (runtime/decode_loop.py) — sampling included, only token ids fetched."""
+    from dllama_tpu.models.params import param_shapes
+    from dllama_tpu.models.transformer import init_kv_cache
+    from dllama_tpu.runtime.decode_loop import decode_chunk
+
+    params = {k: jnp.zeros(s, jnp.float32 if k.startswith("rms") else cfg.dtype)
+              for k, s in param_shapes(cfg).items()}
+    cache = init_kv_cache(cfg, batch=1)
+
+    fn = jax.jit(
+        lambda p, c, tok, pos, k: decode_chunk(
+            p, cfg, c, tok, pos, k, steps=chunk, temperature=0.8, topp=0.9),
+        donate_argnums=(1,))
+
+    tok = jnp.zeros((1,), jnp.int32)
+    key = jax.random.PRNGKey(0)
+    toks, cache, tok, _, _ = fn(params, cache, tok, jnp.int32(0), key)  # warmup/compile
+    np.asarray(toks)
+
+    times = []
+    for i in range(n_chunks):
+        t0 = time.perf_counter()
+        toks, cache, tok, _, _ = fn(params, cache, tok, jnp.int32((i + 1) * chunk), key)
+        np.asarray(toks)  # only K int32 ids cross the host boundary
+        times.append((time.perf_counter() - t0) * 1000 / chunk)
+    return float(np.mean(times))
+
+
+def main():
+    last_err = None
+    for name, cfg, baseline_toks in model_cfgs():
+        try:
+            ms = bench_decode(cfg)
+            toks = 1000.0 / ms
+            vs = toks / baseline_toks if baseline_toks else toks / 9.82
+            print(json.dumps({
+                "metric": f"{name} bf16 decode tok/s (1 TPU v5e chip)",
+                "value": round(toks, 2),
+                "unit": "tok/s",
+                "vs_baseline": round(vs, 2),
+            }))
+            return
+        except Exception as e:  # OOM etc. — try the smaller model
+            last_err = e
+            print(f"bench: {name} failed ({type(e).__name__}: {str(e)[:120]}), "
+                  "falling back", file=sys.stderr)
+    raise SystemExit(f"all bench configs failed: {last_err}")
+
+
+if __name__ == "__main__":
+    main()

@@ -209,12 +209,15 @@ def read_spec(path: str | os.PathLike, weights_ftype: int | None = None) -> Mode
                     raise ValueError(f"unsupported .m header key {k}")
         else:
             raise ValueError(f"unsupported model file magic {magic:#x}")
-    if weights_ftype is not None:
+    # Precedence mirrors the reference: the header's WEIGHTS_FLOAT_TYPE key
+    # overwrites the caller/CLI value (transformer.cpp:66-74 loop overwrites
+    # the argument); the explicit argument only covers files lacking the key.
+    if not found_wft:
+        if weights_ftype is None:
+            raise ValueError(
+                "model file does not specify weights float type; pass weights_ftype "
+                "(reference: 'Not specified weights float type', transformer.cpp:80-81)")
         spec.weights_ftype = weights_ftype
-    elif not found_wft:
-        raise ValueError(
-            "model file does not specify weights float type; pass weights_ftype "
-            "(reference: 'Not specified weights float type', transformer.cpp:80-81)")
     return spec
 
 
@@ -263,8 +266,9 @@ class MFile:
         return quants.q40_planes(self.raw(name), (d, t.shape[-1]))
 
 
-def write_header(f, spec: ModelSpec) -> None:
-    """Write a v2 `.m` header (converter/writer.py:113-143 layout)."""
+def write_header(f, spec: ModelSpec) -> int:
+    """Write a v2 `.m` header; returns its byte count
+    (converter/writer.py:113-143 layout)."""
     pairs = [
         (KEY_VERSION, spec.version),
         (KEY_ARCH_TYPE, spec.arch),
@@ -284,18 +288,18 @@ def write_header(f, spec: ModelSpec) -> None:
     data = b"".join(struct.pack("<ii", k, v) for k, v in pairs)
     f.write(struct.pack("<ii", MAGIC_V2, 8 + len(data)))
     f.write(data)
+    return 8 + len(data)
 
 
 class MFileWriter:
     """Streams tensors into a `.m` file in the canonical order."""
 
     def __init__(self, path: str | os.PathLike, spec: ModelSpec):
-        spec.header_size = 8 + 14 * 8
         self.spec = spec
-        self.plan = tensor_plan(spec)
         self._i = 0
         self._f = open(path, "wb")
-        write_header(self._f, spec)
+        spec.header_size = write_header(self._f, spec)
+        self.plan = tensor_plan(spec)
 
     def write_tensor(self, name: str, x: np.ndarray) -> None:
         expect = self.plan[self._i]

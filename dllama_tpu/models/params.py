@@ -1,0 +1,121 @@
+"""Parameter pytrees: random init (tests) and `.m`-file loading.
+
+Weights are stored **input-dim-first** (``x @ w``) and **layer-stacked**
+(leading ``n_layers`` axis) so the whole transformer body runs as one
+``lax.scan`` — one compiled block program regardless of depth, instead of
+the reference's 25·nLayers-entry static task list (tasks.cpp:36-42).
+
+The `.m` file stores each matmul row-major ``(d_out, n_in)``
+(transformer.cpp:428-487 walk order); the loader dequantizes and transposes
+once on host.  Sharding happens at device placement (parallel/sharding.py),
+which replaces the reference's ``splitWeights`` + socket streaming
+(transformer.cpp:389-404).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io import mfile
+from .config import ModelConfig
+
+Params = dict  # pytree: str -> jnp.ndarray
+
+
+def param_shapes(cfg: ModelConfig) -> dict[str, tuple[int, ...]]:
+    L, D, F, V = cfg.n_layers, cfg.dim, cfg.hidden_dim, cfg.vocab_size
+    Hq = cfg.n_heads * cfg.head_size       # == D
+    Hkv = cfg.n_kv_heads * cfg.head_size   # == kv_dim
+    E = cfg.n_experts
+    shapes = {
+        "embedding": (V, D),
+        "wq": (L, D, Hq),
+        "wk": (L, D, Hkv),
+        "wv": (L, D, Hkv),
+        "wo": (L, Hq, D),
+        "rms_att": (L, D),
+        "rms_ffn": (L, D),
+        "rms_final": (D,),
+        "wcls": (D, V),
+    }
+    if cfg.is_moe:
+        shapes.update({
+            "router": (L, D, E),
+            "up": (L, E, D, F),
+            "gate": (L, E, D, F),
+            "down": (L, E, F, D),
+        })
+        if cfg.post_block_norms:  # Grok-1 extra norms
+            shapes.update({"rms_moe": (L, D), "rms_ffn2": (L, D)})
+    else:
+        shapes.update({"w1": (L, D, F), "w2": (L, F, D), "w3": (L, D, F)})
+    return shapes
+
+
+def init_params(cfg: ModelConfig, seed: int = 0, scale: float = 0.02) -> Params:
+    """Deterministic random params — the analogue of the reference's xorshift
+    weight fixtures (llama2-tasks-test.cpp:556-562)."""
+    rng = np.random.RandomState(seed)
+    params: Params = {}
+    for name, shape in param_shapes(cfg).items():
+        if name.startswith("rms"):
+            x = np.ones(shape, dtype=np.float32)
+        else:
+            x = (rng.standard_normal(shape) * scale).astype(np.float32)
+        params[name] = jnp.asarray(x, dtype=jnp.float32 if name.startswith("rms") else cfg.dtype)
+    return params
+
+
+def _stack(mf: mfile.MFile, names: list[str], transpose: bool, dtype) -> np.ndarray:
+    mats = []
+    for name in names:
+        t = mf.tensor(name)
+        if transpose:
+            t = np.ascontiguousarray(t.T)
+        mats.append(t)
+    return np.stack(mats).astype(dtype)
+
+
+def load_params(mf: mfile.MFile, cfg: ModelConfig | None = None,
+                dtype=None) -> tuple[ModelConfig, Params]:
+    """Load + dequantize a `.m` file into the runtime layout.
+
+    Mirrors ``Transformer::loadRoot`` (transformer.cpp:428-487) but instead
+    of streaming slices to workers, produces host arrays that the engine
+    places onto the mesh with shardings (upload happens once, sliced by
+    XLA, riding PCIe/ICI instead of the reference's TCP star).
+    """
+    if cfg is None:
+        cfg = ModelConfig.from_spec(mf.spec)
+    if dtype is None:
+        dtype = cfg.dtype
+    np_dtype = np.dtype(jnp.dtype(dtype).name) if dtype != jnp.bfloat16 else jnp.bfloat16
+    L = cfg.n_layers
+    p: Params = {}
+    p["embedding"] = mf.tensor("token_embedding").astype(np_dtype)
+    for key, fname, transpose in [
+        ("wq", "wq", True), ("wk", "wk", True), ("wv", "wv", True), ("wo", "wo", True),
+    ]:
+        p[key] = _stack(mf, [f"layers.{i}.{fname}" for i in range(L)], transpose, np_dtype)
+    p["rms_att"] = _stack(mf, [f"layers.{i}.rms_att" for i in range(L)], False, np.float32)
+    p["rms_ffn"] = _stack(mf, [f"layers.{i}.rms_ffn" for i in range(L)], False, np.float32)
+    if cfg.is_moe:
+        p["router"] = _stack(mf, [f"layers.{i}.moe_router" for i in range(L)], True, np_dtype)
+        for key, fname in [("up", "up"), ("gate", "gate"), ("down", "down")]:
+            per_layer = []
+            for i in range(L):
+                mats = [np.ascontiguousarray(mf.tensor(f"layers.{i}.experts.{e}.{fname}").T)
+                        for e in range(cfg.n_experts)]
+                per_layer.append(np.stack(mats))
+            p[key] = np.stack(per_layer).astype(np_dtype)
+        if cfg.post_block_norms:
+            p["rms_moe"] = _stack(mf, [f"layers.{i}.rms_moe" for i in range(L)], False, np.float32)
+            p["rms_ffn2"] = _stack(mf, [f"layers.{i}.rms_ffn2" for i in range(L)], False, np.float32)
+    else:
+        for key in ("w1", "w2", "w3"):
+            p[key] = _stack(mf, [f"layers.{i}.{key}" for i in range(L)], True, np_dtype)
+    p["rms_final"] = mf.tensor("rms_final").astype(np.float32)
+    p["wcls"] = np.ascontiguousarray(mf.tensor("wcls").T).astype(np_dtype)
+    return cfg, {k: jnp.asarray(v) for k, v in p.items()}

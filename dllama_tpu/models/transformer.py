@@ -1,0 +1,192 @@
+"""Unified transformer forward pass: Llama / Mixtral / Grok-1.
+
+One function serves prefill (T > 1) and decode (T == 1): tokens enter as
+``(B, T)``, the KV cache as ``(L, B, Hkv, S, Dh)`` pairs, and ``pos`` is a
+traced scalar, so a single compiled program handles every step of
+autoregression — the TPU answer to the reference's per-token task-list
+execution (`Inference::infer`, tasks.cpp:199-210).
+
+The layer loop is a ``lax.scan`` over layer-stacked weights. Structural
+differences between the three reference task graphs
+(llama2-tasks.cpp:241-298, grok1-tasks.cpp:275-354, mixtral-tasks.cpp:5-78)
+are *static* config properties, so each arch compiles to its own fused
+program:
+
+* Llama   — pre-norm residual attention + SwiGLU FFN
+* Mixtral — same attention, MoE FFN, rotate-half RoPE
+* Grok-1  — embedding ×78.38…, post-sub-block rmsnorms before each residual
+            add, MoE with GELU, logits ×0.577…
+
+Tensor-parallel execution needs no code here: weights arrive sharded
+(parallel/sharding.py) and XLA inserts the all-reduces the reference
+hand-rolls as gather+merge (llama2-tasks.cpp:115-131).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import gqa_attention, update_kv_cache
+from ..ops.kernels import ACTIVATIONS, apply_rope, rmsnorm, rope_angles, softmax_f32
+from .config import ModelConfig
+from .params import Params
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # (L, B, Hkv, S, Dh)
+    v: jax.Array
+
+
+def init_kv_cache(cfg: ModelConfig, batch: int, seq_len: int | None = None,
+                  dtype=None) -> KVCache:
+    """Preallocated full-length cache (reference: transformer.cpp:280-282).
+
+    The reference holds F32 caches; dtype is configurable here because a
+    bf16 cache halves HBM traffic in the decode attention — the main
+    bandwidth consumer at long context.
+    """
+    s = seq_len or cfg.seq_len
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, s, cfg.head_size)
+    dt = dtype or cfg.dtype
+    return KVCache(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+
+
+def _attention_block(x, lp, cfg: ModelConfig, k_cache, v_cache, cos, sin, pos):
+    b, t, d = x.shape
+    hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_size
+
+    xb = rmsnorm(x, lp["rms_att"])
+    q = (xb @ lp["wq"]).reshape(b, t, hq, dh)
+    k = (xb @ lp["wk"]).reshape(b, t, hkv, dh)
+    v = (xb @ lp["wv"]).reshape(b, t, hkv, dh)
+
+    q = apply_rope(q, cos, sin, interleaved=cfg.rope_interleaved)
+    k = apply_rope(k, cos, sin, interleaved=cfg.rope_interleaved)
+
+    q = q.transpose(0, 2, 1, 3)  # (B, Hq, T, Dh)
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    k_cache, v_cache = update_kv_cache(k_cache, v_cache, k, v, pos)
+
+    att = gqa_attention(q, k_cache, v_cache, pos, t)
+    att = att.transpose(0, 2, 1, 3).reshape(b, t, hq * dh)
+    out = att @ lp["wo"]  # col-sharded: XLA all-reduces the partial sums here
+    return out, k_cache, v_cache
+
+
+def _dense_ffn(xb, lp, cfg: ModelConfig):
+    act = ACTIVATIONS[cfg.hidden_act]
+    h = act(xb @ lp["w1"]) * (xb @ lp["w3"])
+    return h @ lp["w2"]
+
+
+def moe_ffn(xb2d: jax.Array, lp, cfg: ModelConfig) -> jax.Array:
+    """Mixture-of-experts FFN (grok1-tasks.cpp:56-228 semantics).
+
+    Routing: softmax over *all* expert logits, top-k, renormalize the
+    selected probabilities (grokMoeRouterSoftmax/Topk/NormWeights,
+    grok1-tasks.cpp:60-114).
+
+    Two execution strategies, chosen statically by token count:
+    * decode (few tokens): gather the k selected experts' weights from HBM
+      — reads only k/E of the MoE bytes, which is what bounds decode.
+    * prefill (many tokens): run every expert densely on the MXU and mask —
+      regular shapes, no data-dependent gathers in the hot loop.
+
+    Experts are TP-sliced like the reference (all experts on all shards,
+    hidden dim sharded — transformer.cpp:299-317); expert-parallel layouts
+    are a sharding-spec change, not a code change.
+    """
+    n, d = xb2d.shape
+    e, k = cfg.n_experts, cfg.n_active_experts
+    act = ACTIVATIONS[cfg.hidden_act]
+
+    router_logits = xb2d.astype(jnp.float32) @ lp["router"].astype(jnp.float32)  # (N, E)
+    probs = softmax_f32(router_logits)
+    top_vals, top_idx = jax.lax.top_k(probs, k)  # (N, k)
+    weights = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+
+    if n <= 4:  # decode path: gather selected experts' weights
+        up_w = jnp.take(lp["up"], top_idx, axis=0)      # (N, k, D, F)
+        gate_w = jnp.take(lp["gate"], top_idx, axis=0)  # (N, k, D, F)
+        down_w = jnp.take(lp["down"], top_idx, axis=0)  # (N, k, F, D)
+        h = act(jnp.einsum("nd,nkdf->nkf", xb2d, gate_w)) * jnp.einsum("nd,nkdf->nkf", xb2d, up_w)
+        out = jnp.einsum("nkf,nkfd->nkd", h, down_w)
+        return jnp.einsum("nk,nkd->nd", weights.astype(out.dtype), out)
+
+    # prefill path: dense dispatch over all experts
+    h = act(jnp.einsum("nd,edf->nef", xb2d, lp["gate"])) * jnp.einsum("nd,edf->nef", xb2d, lp["up"])
+    outs = jnp.einsum("nef,efd->ned", h, lp["down"])
+    dense_w = jnp.zeros((n, e), weights.dtype)
+    dense_w = jnp.put_along_axis(dense_w, top_idx, weights, axis=-1, inplace=False)
+    return jnp.einsum("ne,ned->nd", dense_w.astype(outs.dtype), outs)
+
+
+def run_blocks(params: Params, cfg: ModelConfig, tokens: jax.Array,
+               cache: KVCache, pos: jax.Array) -> tuple[jax.Array, KVCache]:
+    """Embed + all transformer blocks; returns the residual stream (B, T, D)
+    and the updated cache."""
+    b, t = tokens.shape
+    x = jnp.take(params["embedding"], tokens, axis=0).astype(cfg.dtype)
+    if cfg.embedding_scale != 1.0:
+        x = x * jnp.asarray(cfg.embedding_scale, cfg.dtype)
+
+    positions = pos + jnp.arange(t)
+    cos, sin = rope_angles(positions, cfg.head_size, cfg.rope_theta)  # (T, Dh/2)
+
+    layer_keys = [k for k in params if k not in ("embedding", "rms_final", "wcls")]
+    stacked = {k: params[k] for k in layer_keys}
+
+    def block(x, layer):
+        lp, k_cache, v_cache = layer
+        att_out, k_cache, v_cache = _attention_block(x, lp, cfg, k_cache, v_cache, cos, sin, pos)
+        if cfg.post_block_norms:
+            att_out = rmsnorm(att_out, lp["rms_ffn"])  # grokRmfFfnNorm
+        x = x + att_out
+
+        if cfg.is_moe:
+            pre = lp["rms_moe"] if cfg.post_block_norms else lp["rms_ffn"]
+            xb = rmsnorm(x, pre)
+            ff = moe_ffn(xb.reshape(b * t, cfg.dim), lp, cfg).reshape(b, t, cfg.dim)
+            if cfg.post_block_norms:
+                ff = rmsnorm(ff, lp["rms_ffn2"])  # grokMoeRmsNormFinal
+        else:
+            xb = rmsnorm(x, lp["rms_ffn"])
+            ff = _dense_ffn(xb, lp, cfg)
+        x = x + ff
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(block, x, (stacked, cache.k, cache.v))
+    return x, KVCache(k_new, v_new)
+
+
+def _head(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
+    x = rmsnorm(x, params["rms_final"])
+    logits = (x @ params["wcls"]).astype(jnp.float32)
+    if cfg.logit_scale != 1.0:
+        logits = logits * cfg.logit_scale
+    return logits
+
+
+def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
+            cache: KVCache, pos: jax.Array) -> tuple[jax.Array, KVCache]:
+    """Run the model over ``tokens`` (B, T) starting at position ``pos``.
+
+    Returns logits (B, T, V) in f32 and the updated cache.
+    """
+    x, cache = run_blocks(params, cfg, tokens, cache, pos)
+    return _head(params, cfg, x), cache
+
+
+def forward_last(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                 cache: KVCache, pos: jax.Array, last_index: jax.Array
+                 ) -> tuple[jax.Array, KVCache]:
+    """Like :func:`forward` but applies the LM head only at ``last_index``,
+    returning (B, V) — avoids materializing (T, V) logits during prefill
+    when only the next-token distribution is needed."""
+    x, cache = run_blocks(params, cfg, tokens, cache, pos)
+    x_last = jax.lax.dynamic_slice_in_dim(x, last_index, 1, axis=1)[:, 0]  # (B, D)
+    return _head(params, cfg, x_last), cache

@@ -1,0 +1,72 @@
+"""Grouped-query attention with a persistent KV cache.
+
+TPU-native replacement for the reference's per-head scalar attention loop
+(/root/reference/src/llama2-tasks.cpp:54-94): instead of iterating heads ×
+positions on a thread pool, the whole (batch, heads, q_len, kv_len) score
+tensor is one batched einsum on the MXU, with causal/position masking done
+with an iota comparison (static shapes; ``pos`` is a traced scalar so one
+compiled program serves every decode step).
+
+The KV cache layout is ``(batch, n_kv_heads, seq_len, head_size)`` — the
+kv-head axis is the reference's ``KvCacheSlice`` dim (commands.cpp:94-99)
+and is the axis sharded across the tensor-parallel mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .kernels import softmax_f32
+
+
+def update_kv_cache(k_cache: jax.Array, v_cache: jax.Array,
+                    k_new: jax.Array, v_new: jax.Array,
+                    pos: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Write ``k_new``/``v_new`` (B, Hkv, T, Dh) into the caches at ``pos``.
+
+    The reference appends at ``pos`` into its per-slice cache
+    (llama2-tasks.cpp:33-45 writes k/v straight into the cache row); here it
+    is a dynamic_update_slice on the seq axis, which XLA lowers to an
+    in-place HBM update because the cache is a donated buffer.
+    """
+    k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k_new.astype(k_cache.dtype), pos, axis=2)
+    v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v_new.astype(v_cache.dtype), pos, axis=2)
+    return k_cache, v_cache
+
+
+def gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                  pos: jax.Array, q_len: int) -> jax.Array:
+    """Causal GQA over the cache.
+
+    q:        (B, Hq, T, Dh) — already RoPE'd
+    k_cache:  (B, Hkv, S, Dh) — positions ≥ pos+T are garbage and masked out
+    v_cache:  (B, Hkv, S, Dh)
+    pos:      scalar, index of q's first token
+    returns:  (B, Hq, T, Dh)
+
+    Scale is 1/sqrt(head_size) (llama2-tasks.cpp:67).  GQA head grouping
+    ``kvMul = nHeads/nKvHeads`` (llama2-tasks.cpp:58) becomes a reshape to
+    (B, Hkv, G, T, Dh) so each kv head serves G query heads in one einsum.
+    """
+    b, hq, t, dh = q.shape
+    hkv = k_cache.shape[1]
+    s = k_cache.shape[2]
+    g = hq // hkv
+
+    qf = q.astype(jnp.float32).reshape(b, hkv, g, t, dh)
+    kf = k_cache.astype(jnp.float32)
+    vf = v_cache.astype(jnp.float32)
+
+    scores = jnp.einsum("bhgtd,bhsd->bhgts", qf, kf) / jnp.sqrt(jnp.float32(dh))
+
+    # causal + validity mask: key position s_idx is visible to query t_idx
+    # iff s_idx <= pos + t_idx
+    s_idx = jnp.arange(s)[None, :]
+    t_idx = pos + jnp.arange(t)[:, None]
+    mask = s_idx <= t_idx  # (T, S)
+    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+
+    probs = softmax_f32(scores, axis=-1)
+    out = jnp.einsum("bhgts,bhsd->bhgtd", probs, vf)
+    return out.reshape(b, hq, t, dh).astype(q.dtype)

@@ -1,0 +1,58 @@
+"""Device-mesh construction.
+
+The reference's cluster topology is ``--workers host:port …`` — a TCP star
+of 2^n CPU nodes (socket.cpp:160-185).  Here the topology is a
+``jax.sharding.Mesh`` over TPU chips on ICI; the CLI keeps the contract as
+``--workers tpu:N``.
+
+Axes:
+* ``tp`` — tensor parallel: the reference's slice index
+  (RowMatmulSlice/ColMatmulSlice, commands.cpp:8-70).
+* ``sp`` — sequence parallel (ring attention) for long context; the
+  reference has no equivalent (SURVEY §5: its only long-context lever is
+  TP's 1/n KV shrink).
+* ``dp`` — data parallel over batch; the reference is fixed batch-1.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(tp: int | None = None, sp: int = 1, dp: int = 1,
+              devices=None) -> Mesh:
+    """Build a (dp, sp, tp) mesh; tp defaults to all remaining devices.
+
+    tp is the innermost axis so tensor-parallel collectives ride the
+    fastest ICI links (the scaling-book recipe: put the most
+    bandwidth-hungry axis innermost).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if tp is None:
+        tp = len(devices) // (sp * dp)
+    n = dp * sp * tp
+    if n > len(devices):
+        raise ValueError(f"mesh {dp}x{sp}x{tp} needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(dp, sp, tp)
+    return Mesh(arr, axis_names=("dp", "sp", "tp"))
+
+
+def parse_workers(workers: str | None, devices=None) -> Mesh:
+    """Parse the CLI ``--workers`` value into a mesh.
+
+    ``tpu:N`` → N-way tensor parallel (the BASELINE.json north-star form);
+    ``None``/"" → all local devices, pure TP.
+    Host:port worker lists are the reference's CPU-cluster transport and are
+    intentionally not supported — the transport here is XLA collectives.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if not workers:
+        return make_mesh(devices=devices)
+    if workers.startswith("tpu:"):
+        n = int(workers.split(":", 1)[1])
+        return make_mesh(tp=n, devices=devices)
+    raise ValueError(
+        f"unsupported --workers value {workers!r}: this framework replaces the "
+        "TCP star with a TPU mesh; use 'tpu:N'")

@@ -1,0 +1,107 @@
+"""Sharding specs: the TP slice layout as `NamedSharding` PartitionSpecs.
+
+This module is the direct TPU-native port of the reference's slicing math
+(`/root/reference/src/commands.cpp:8-105`):
+
+* ``RowMatmulSlice`` (split the *output* dim: wq/wk/wv, w1/w3, MoE up/gate/
+  down, transformer.cpp:287-289,300-301,319-321) → shard the weight's
+  output axis on ``tp``; activations come out head/hidden-sharded with NO
+  communication (the reference's broadcast of the replicated input,
+  syncUnitBuffer tasks.cpp:44-65, is free here because the input is already
+  replicated on every chip).
+* ``ColMatmulSlice`` (split the *input* dim: wo, w2,
+  transformer.cpp:290,320) → shard the weight's input axis on ``tp``; XLA
+  inserts one all-reduce for the partial sums, replacing the reference's
+  gather-to-root + merge + re-broadcast round trip
+  (llama2-tasks.cpp:115-131,153-156).
+* ``KvCacheSlice`` (commands.cpp:94-99) → shard the cache's kv-head axis.
+* ``MultiHeadAttSlice``/``RopeSlice`` (commands.cpp:72-92,101-105) → free:
+  head-sharded q/k/v make per-head attention and RoPE local by
+  construction.
+
+The reference's constraints carry over: ``nSlices ≤ nKvHeads``
+(transformer.cpp:88-91) is checked in :func:`check_tp_constraint`; the 2^n
+node-count restriction disappears (any divisor of the head counts works).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+
+REPL = P()
+
+
+def check_tp_constraint(cfg: ModelConfig, tp: int) -> None:
+    """Reference parity: cannot split across more nodes than KV heads
+    (transformer.cpp:88-91).  Head counts must divide evenly because a
+    shard owns whole heads (MultiHeadAttSlice asserts nHeads % nSlices == 0,
+    commands.cpp:101-105)."""
+    if tp > cfg.n_kv_heads:
+        raise ValueError(
+            f"tensor-parallel degree {tp} exceeds nKvHeads={cfg.n_kv_heads} "
+            "(reference: 'This version does not support more nodes than the "
+            "number of KV heads', transformer.cpp:88-91)")
+    if cfg.n_heads % tp or cfg.n_kv_heads % tp:
+        raise ValueError(f"head counts ({cfg.n_heads}/{cfg.n_kv_heads}) not divisible by tp={tp}")
+    if cfg.hidden_dim % tp:
+        raise ValueError(f"hidden_dim {cfg.hidden_dim} not divisible by tp={tp}")
+
+
+def param_specs(cfg: ModelConfig) -> dict[str, P]:
+    """PartitionSpec per parameter (layer-stacked layouts from params.py)."""
+    specs = {
+        "embedding": REPL,                   # root-owned in the reference; replicated here
+        "wq": P(None, None, "tp"),           # RowMatmulSlice: out dim = heads
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),           # ColMatmulSlice: in dim = heads
+        "rms_att": REPL,
+        "rms_ffn": REPL,
+        "rms_final": REPL,
+        "wcls": P(None, "tp"),               # vocab-sharded logits; gathered on host fetch
+    }
+    if cfg.is_moe:
+        specs.update({
+            "router": REPL,                  # root-computed in the reference (grok1-tasks.cpp:59)
+            "up": P(None, None, None, "tp"),    # dense-TP MoE: every expert on every
+            "gate": P(None, None, None, "tp"),  # shard, hidden dim sliced
+            "down": P(None, None, "tp", None),  # (transformer.cpp:299-317)
+        })
+        if cfg.post_block_norms:
+            specs.update({"rms_moe": REPL, "rms_ffn2": REPL})
+    else:
+        specs.update({
+            "w1": P(None, None, "tp"),
+            "w2": P(None, "tp", None),
+            "w3": P(None, None, "tp"),
+        })
+    return specs
+
+
+def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, NamedSharding]:
+    return {k: NamedSharding(mesh, spec) for k, spec in param_specs(cfg).items()}
+
+
+def kv_cache_spec(seq_axis: str | None = None) -> P:
+    """Cache (L, B, Hkv, S, Dh): kv-head axis on tp (KvCacheSlice,
+    commands.cpp:94-99); optionally the seq axis on ``sp`` for
+    sequence-parallel long context."""
+    return P(None, "dp", "tp", seq_axis, None)
+
+
+def kv_cache_sharding(mesh: Mesh, seq_axis: str | None = None) -> NamedSharding:
+    return NamedSharding(mesh, kv_cache_spec(seq_axis))
+
+
+def place_params(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
+    """Upload host params onto the mesh with their TP shardings.
+
+    This replaces the reference's weight-distribution phase
+    (``loadRoot`` streaming slices over sockets, transformer.cpp:389-404):
+    `jax.device_put` slices each array and uploads only each chip's shard.
+    """
+    shardings = param_shardings(cfg, mesh)
+    return {k: jax.device_put(v, shardings[k]) for k, v in params.items()}

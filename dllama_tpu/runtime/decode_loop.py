@@ -1,0 +1,74 @@
+"""On-device generation loop: K decode steps + sampling in one XLA program.
+
+The reference's decode loop crosses the host boundary every token — logits
+to the host sampler, the sampled token back to the cluster
+(`generate` dllama.cpp:53-72, `Sampler::sample` tokenizer.cpp:384-407).
+On a tunneled/remote TPU that round trip costs ~100 ms, dwarfing the
+~20 ms device step.  Here the whole sample→embed→forward chain runs inside
+a ``lax.scan``: one dispatch yields a chunk of K tokens and only the int32
+token ids cross the boundary.
+
+Sampling parity: greedy (temperature 0) is exact argmax, identical to the
+reference.  Temperature/top-p uses the JAX counter-based PRNG instead of
+the reference's xorshift stream — same distribution, different stream; the
+host Sampler (sampling.py) remains available for bit-exact parity runs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig
+from ..models.transformer import KVCache, forward_last
+from ..ops.kernels import softmax_f32
+
+
+def device_sample(logits: jax.Array, key: jax.Array, temperature: float,
+                  topp: float) -> jax.Array:
+    """Sample token ids (B,) from logits (B, V) on device.
+
+    Mirrors Sampler::sample's three modes (tokenizer.cpp:384-407):
+    temperature 0 → argmax; top-p outside (0,1) → plain multinomial;
+    otherwise nucleus sampling.  ``temperature``/``topp`` are static so each
+    mode compiles to its own minimal program.
+    """
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    probs = softmax_f32(logits / temperature)  # (B, V)
+    if topp <= 0.0 or topp >= 1.0:
+        return jax.random.categorical(key, jnp.log(probs), axis=-1).astype(jnp.int32)
+
+    # nucleus: sort descending, keep the smallest prefix with mass > topp
+    # (tokenizer.cpp:328-369 semantics), renormalize, sample within it
+    sorted_probs, sorted_idx = jax.lax.top_k(probs, probs.shape[-1])
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    keep = (cum - sorted_probs) < topp  # include the first token crossing topp
+    filtered = jnp.where(keep, sorted_probs, 0.0)
+    choice = jax.random.categorical(key, jnp.log(filtered), axis=-1)  # index into sorted order
+    return jnp.take_along_axis(sorted_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+
+def decode_chunk(params, cfg: ModelConfig, cache: KVCache, token: jax.Array,
+                 pos: jax.Array, key: jax.Array, *, steps: int,
+                 temperature: float, topp: float):
+    """Generate ``steps`` tokens starting from ``token`` (B,) at ``pos``.
+
+    Returns (tokens (steps, B), cache, last_token, new_pos, key).  The
+    caller jits this with ``steps``/``temperature``/``topp`` static and the
+    cache donated.
+    """
+
+    def body(carry, _):
+        cache, token, pos, key = carry
+        logits, cache = forward_last(params, cfg, token[:, None], cache, pos, jnp.int32(0))
+        key, sub = jax.random.split(key)
+        nxt = device_sample(logits, sub, temperature, topp)
+        return (cache, nxt, pos + 1, key), nxt
+
+    (cache, last, pos, key), toks = jax.lax.scan(
+        body, (cache, token, pos, key), None, length=steps)
+    return toks, cache, last, pos, key

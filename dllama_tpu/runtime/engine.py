@@ -1,0 +1,263 @@
+"""Inference engine: compiled prefill/decode + generation loop + stats.
+
+Replaces the reference's execution layer (`Inference::infer` tasks.cpp:199-
+210 + the per-token task-list walk): here a *whole decode step* — embed,
+all layers, logits — is one XLA program with ``pos`` as a traced scalar, so
+autoregression never recompiles, and the KV cache is a donated device
+buffer updated in place.
+
+Prefill is a separate bucketed program (prompt padded up to the next
+bucket) that processes the whole prompt in one batched pass — the reference
+feeds prompt tokens one at a time (dllama.cpp:53-58), which is parity-fine
+but wastes the MXU; true prefill is the TPU-idiomatic replacement.
+
+Stats keep the reference's per-token G/I/T contract (dllama.cpp:45-93,
+`Inference::getStats` tasks.cpp:212-215): G = whole-step wall ms, I =
+on-device compute ms, T = device→host transfer ms.  On the reference, T is
+socket time between nodes; on a TPU mesh the inter-chip hops are XLA
+collectives *inside* I (that's the point — T ≈ 0), so T here counts the
+only remaining boundary: fetching logits for the host-side sampler.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.config import ModelConfig
+from ..models.params import Params
+from ..models.transformer import KVCache, forward_last, init_kv_cache
+from ..parallel import sharding
+from ..parallel.mesh import make_mesh
+from ..sampling import Sampler
+
+
+def _next_bucket(n: int, minimum: int = 16) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class StepStats:
+    """Per-token timing, reference benchmark-mode contract (dllama.cpp:74-82)."""
+    generation_ms: float = 0.0  # G: total wall time for the token
+    inference_ms: float = 0.0   # I: device execution
+    transfer_ms: float = 0.0    # T: host<->device boundary
+
+
+@dataclass
+class RunStats:
+    tokens: list[StepStats] = field(default_factory=list)
+
+    def add(self, s: StepStats):
+        self.tokens.append(s)
+
+    @property
+    def avg_generation_ms(self):
+        return float(np.mean([t.generation_ms for t in self.tokens])) if self.tokens else 0.0
+
+    @property
+    def avg_inference_ms(self):
+        return float(np.mean([t.inference_ms for t in self.tokens])) if self.tokens else 0.0
+
+    @property
+    def avg_transfer_ms(self):
+        return float(np.mean([t.transfer_ms for t in self.tokens])) if self.tokens else 0.0
+
+    @property
+    def tokens_per_second(self):
+        g = self.avg_generation_ms
+        return 1000.0 / g if g > 0 else 0.0
+
+
+class Engine:
+    """Owns placed params, the KV cache, and the compiled step functions."""
+
+    def __init__(self, cfg: ModelConfig, params: Params, mesh=None,
+                 batch: int = 1, seq_len: int | None = None, kv_dtype=None):
+        self.cfg = cfg
+        self.batch = batch
+        self.seq_len = min(seq_len or cfg.seq_len, cfg.seq_len)
+        self.mesh = mesh if mesh is not None else make_mesh(tp=1, devices=jax.devices()[:1])
+        tp = self.mesh.shape.get("tp", 1)
+        if tp > 1:
+            sharding.check_tp_constraint(cfg, tp)
+        self.params = sharding.place_params(params, cfg, self.mesh)
+        self.cache = jax.device_put(
+            init_kv_cache(cfg, batch, self.seq_len, dtype=kv_dtype),
+            sharding.kv_cache_sharding(self.mesh))
+        self.pos = 0
+
+        def step(params, cache, tokens, pos, last_index):
+            return forward_last(params, cfg, tokens, cache, pos, last_index)
+
+        # one compiled program per (batch, T-bucket); decode is bucket T=1
+        self._step = jax.jit(step, donate_argnums=(1,), static_argnames=())
+        self._chunk_fns: dict = {}
+        self._key = jax.random.PRNGKey(0)
+        self._chunk_counter = 0
+
+    # ------------------------------------------------------------------
+    def reset(self):
+        """Restart the sequence (new conversation); cache memory is reused."""
+        self.pos = 0
+
+    def _run(self, tokens_np: np.ndarray, last_index: int) -> tuple[np.ndarray, StepStats]:
+        stats = StepStats()
+        t0 = time.perf_counter()
+        logits, self.cache = self._step(
+            self.params, self.cache, jnp.asarray(tokens_np),
+            jnp.int32(self.pos), jnp.int32(last_index))
+        logits.block_until_ready()
+        t1 = time.perf_counter()
+        host_logits = np.asarray(logits)  # (B, V)
+        t2 = time.perf_counter()
+        stats.inference_ms = (t1 - t0) * 1000
+        stats.transfer_ms = (t2 - t1) * 1000
+        stats.generation_ms = (t2 - t0) * 1000
+        return host_logits, stats
+
+    def prefill(self, prompt_tokens: list[int]) -> tuple[np.ndarray, StepStats]:
+        """Process the whole prompt; returns logits for its last token."""
+        n = len(prompt_tokens)
+        if n == 0:
+            raise ValueError("empty prompt")
+        if self.pos + n > self.seq_len:
+            raise ValueError(f"prompt of {n} exceeds seq_len {self.seq_len} at pos {self.pos}")
+        bucket = min(_next_bucket(n), self.seq_len)
+        if bucket < n:
+            bucket = n
+        toks = np.zeros((self.batch, bucket), np.int32)
+        toks[:, :n] = prompt_tokens
+        logits, stats = self._run(toks, n - 1)
+        self.pos += n
+        return logits, stats
+
+    def decode_one(self, token: int) -> tuple[np.ndarray, StepStats]:
+        """One autoregressive step at the current position."""
+        if self.pos >= self.seq_len:
+            raise ValueError(f"position {self.pos} at seq_len limit {self.seq_len}")
+        toks = np.full((self.batch, 1), token, np.int32)
+        logits, stats = self._run(toks, 0)
+        self.pos += 1
+        return logits, stats
+
+    # ------------------------------------------------------------------
+    def _chunk_fn(self, steps: int, temperature: float, topp: float):
+        """Compiled on-device K-step generation loop (runtime/decode_loop.py)."""
+        from .decode_loop import decode_chunk
+        key = (steps, float(temperature), float(topp))
+        if key not in self._chunk_fns:
+            cfg = self.cfg
+            self._chunk_fns[key] = jax.jit(
+                lambda p, c, tok, pos, k: decode_chunk(
+                    p, cfg, c, tok, pos, k,
+                    steps=steps, temperature=key[1], topp=key[2]),
+                donate_argnums=(1,))
+        return self._chunk_fns[key]
+
+    def generate_stream(self, prompt_tokens: list[int], steps: int, *,
+                        temperature: float = 0.0, topp: float = 0.9,
+                        seed: int = 0, eos_ids: tuple[int, ...] = (),
+                        chunk: int = 16):
+        """High-throughput generation: sampling and the decode loop run on
+        device; token ids stream back in chunks.
+
+        Yields ``(token_id, StepStats)``.  Prompt tokens are echoed first
+        (reference generate-mode contract, dllama.cpp:45-93); the per-token
+        stats of a chunk are the chunk averages.
+        """
+        steps = min(steps, self.seq_len - self.pos)
+        self._key = jax.random.PRNGKey(seed)
+        self._chunk_counter = 0
+
+        logits, pstats = self.prefill(prompt_tokens[:])
+        for i, t in enumerate(prompt_tokens):
+            yield t, pstats if i == len(prompt_tokens) - 1 else StepStats()
+        produced = len(prompt_tokens)
+        if produced >= steps:
+            return
+
+        sampler = Sampler(self.cfg.vocab_size, temperature, topp, seed)
+        token = int(sampler.sample(logits[0]))
+        yield token, pstats
+        produced += 1
+        if token in eos_ids:
+            return
+
+        while produced < steps and self.pos < self.seq_len:
+            k = min(chunk, steps - produced, self.seq_len - self.pos)
+            fn = self._chunk_fn(k, temperature, topp)
+            sub = jax.random.fold_in(self._key, self._chunk_counter)
+            self._chunk_counter += 1
+            p0 = self.pos
+            t0 = time.perf_counter()
+            toks_dev, self.cache, _last, _pos, _key = fn(
+                self.params, self.cache,
+                jnp.full((self.batch,), token, jnp.int32), jnp.int32(p0), sub)
+            jax.block_until_ready(toks_dev)
+            t1 = time.perf_counter()
+            toks = np.asarray(toks_dev)[:, 0]  # (k,)
+            t2 = time.perf_counter()
+            self.pos = p0 + k
+            per = StepStats(
+                generation_ms=(t2 - t0) * 1000 / k,
+                inference_ms=(t1 - t0) * 1000 / k,
+                transfer_ms=(t2 - t1) * 1000 / k)
+            for j, tk in enumerate(toks.tolist()):
+                token = int(tk)
+                yield token, per
+                produced += 1
+                if token in eos_ids:
+                    # rewind past the unconsumed overshoot so a following
+                    # turn prefills at the right position (masked rows are
+                    # never attended and get overwritten)
+                    self.pos = p0 + j + 1
+                    return
+                if produced >= steps:
+                    return
+
+    def generate(self, prompt_tokens: list[int], steps: int, sampler: Sampler,
+                 eos_ids: tuple[int, ...] = (), prefill_single_token: bool = False):
+        """Yield ``(token_id, stats)`` for up to ``steps`` generated tokens.
+
+        Mirrors the reference generate loop (dllama.cpp:17-93): prompt
+        tokens are consumed first (emitted with their stats but not
+        sampled), then sampled tokens stream out until ``steps`` tokens
+        total, seq_len, or an EOS id.  ``prefill_single_token=True``
+        reproduces the reference's token-at-a-time prefill for parity
+        testing.
+        """
+        steps = min(steps, self.seq_len - self.pos)
+        produced = 0
+        if prefill_single_token:
+            logits = None
+            for t in prompt_tokens:
+                logits, stats = self.decode_one(t)
+                produced += 1
+                yield t, stats
+                if produced >= steps:
+                    return
+        else:
+            logits, stats = self.prefill(prompt_tokens[:])
+            produced += len(prompt_tokens)
+            for i, t in enumerate(prompt_tokens):
+                yield t, stats if i == len(prompt_tokens) - 1 else StepStats()
+            if produced >= steps:
+                return
+
+        token = int(sampler.sample(logits[0]))
+        while True:
+            yield token, stats
+            produced += 1
+            if produced >= steps or self.pos >= self.seq_len or token in eos_ids:
+                return
+            logits, stats = self.decode_one(token)
+            token = int(sampler.sample(logits[0]))

@@ -38,12 +38,6 @@ class Tokenizer:
         # duplicate strings (reference str_lookup, tokenizer.cpp:163-168)
         for i, piece in enumerate(self.vocab):
             self._index.setdefault(piece, i)
-        self._byte_pieces: dict[int, int] = {}
-        for i, piece in enumerate(self.vocab):
-            m = _BYTE_PIECE_RE.match(piece)
-            if m:
-                self._byte_pieces.setdefault(int(m.group(1), 16), i)
-
     def lookup(self, piece: bytes) -> int:
         return self._index.get(piece, -1)
 

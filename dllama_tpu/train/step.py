@@ -1,0 +1,43 @@
+"""Training step (beyond-reference capability).
+
+The reference is inference-only (SURVEY §0), but the functional forward
+pass makes a training step nearly free in JAX: cross-entropy loss +
+``jax.grad`` + an optax optimizer, jitted over the same mesh/shardings as
+inference.  This is what ``__graft_entry__.dryrun_multichip`` exercises to
+prove the multi-chip shardings compile end-to-end (forward *and* backward
+collectives).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..models.config import ModelConfig
+from ..models.transformer import forward, init_kv_cache
+
+
+def cross_entropy_loss(params, cfg: ModelConfig, tokens: jax.Array) -> jax.Array:
+    """Next-token cross entropy over ``tokens`` (B, T+1)."""
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    cache = init_kv_cache(cfg, inputs.shape[0], inputs.shape[1])
+    logits, _ = forward(params, cfg, inputs, cache, jnp.int32(0))
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation):
+    """Returns ``train_step(params, opt_state, tokens) -> (params, opt_state,
+    loss)`` — jit it with the caller's shardings/donations."""
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(cross_entropy_loss)(params, cfg, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step

@@ -3,12 +3,20 @@
 Tests run on CPU with 8 virtual XLA devices so the multi-chip sharding path
 (tensor/sequence parallel over a `jax.sharding.Mesh`) compiles and executes
 without TPU hardware — the same trick the driver uses for
-``__graft_entry__.dryrun_multichip``.  Must run before the first jax import.
+``__graft_entry__.dryrun_multichip``.
+
+The session environment pins JAX to the TPU tunnel (JAX_PLATFORMS=axon set
+by sitecustomize *and* baked into jax.config at interpreter start), so a
+plain env-var override is ignored; the config update below is what actually
+forces CPU.  It must happen before the first backend query.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
