@@ -1,0 +1,167 @@
+"""HF safetensors → `.m` converter.
+
+Re-implements `/root/reference/converter/convert-hf.py`: llama / mistral /
+mixtral folders with ``config.json`` + ``*.safetensors`` become a `.m` file
+in the canonical tensor order.  Key semantics preserved:
+
+* q/k head permutation (convert-hf.py:12-15): HF stores RoPE in rotate-half
+  layout; the `.m` format expects the interleaved-pair layout, so q and k
+  rows are permuted ``(h, 2, hs/2) → (h, hs/2, 2)``.  The reference applies
+  this to every arch (including Mixtral, whose runtime then rotates
+  neox-style — a reference quirk preserved for file-format parity).
+* dense FFN file order gate/down/up = w1/w2/w3 (convert-hf.py:77-83);
+  MoE per-expert order up(w3)/gate(w1)/down(w2) (convert-hf.py:68-75).
+
+Usage: python convert_hf.py <sourceFolderPath> <weightsFloatType> <name>
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dllama_tpu import quants  # noqa: E402
+from dllama_tpu.io import mfile  # noqa: E402
+
+ARCH_BY_MODEL_TYPE = {
+    "llama": mfile.ARCH_LLAMA,
+    "mistral": mfile.ARCH_LLAMA,
+    "mixtral": mfile.ARCH_MIXTRAL,
+}
+HIDDEN_ACT = {"gelu": mfile.ACT_GELU, "silu": mfile.ACT_SILU}
+
+
+def permute(t: np.ndarray, n_heads: int, n_kv_heads: int) -> np.ndarray:
+    """Rotate-half → interleaved head layout (convert-hf.py:12-15)."""
+    if n_heads != n_kv_heads:
+        n_heads = n_kv_heads
+    return (t.reshape(n_heads, 2, t.shape[0] // n_heads // 2, *t.shape[1:])
+             .swapaxes(1, 2).reshape(t.shape))
+
+
+def load_spec(folder: str, weights_ftype: int) -> mfile.ModelSpec:
+    with open(os.path.join(folder, "config.json")) as f:
+        config = json.load(f)
+    arch = ARCH_BY_MODEL_TYPE.get(config["model_type"])
+    if arch is None:
+        raise SystemExit(f"Unsupported arch type: {config['model_type']}")
+    n_experts = config.get("num_local_experts") or 0
+    n_active = (config.get("num_active_local_experts")
+                or config.get("num_experts_per_tok") or 0)
+    return mfile.ModelSpec(
+        arch=arch,
+        dim=config["hidden_size"],
+        hidden_dim=config["intermediate_size"],
+        n_layers=config["num_hidden_layers"],
+        n_heads=config["num_attention_heads"],
+        n_kv_heads=config["num_key_value_heads"],
+        n_experts=int(n_experts),
+        n_active_experts=int(n_active),
+        vocab_size=config["vocab_size"],
+        seq_len=config["max_position_embeddings"],
+        hidden_act=HIDDEN_ACT[config.get("hidden_act", "silu")],
+        rope_theta=float(config.get("rope_theta", 10000.0)),
+        weights_ftype=weights_ftype)
+
+
+class SafetensorsStore:
+    """Lazy multi-file tensor lookup over a model folder."""
+
+    def __init__(self, folder: str):
+        from safetensors import safe_open
+        self._handles = {}
+        self._index: dict[str, str] = {}
+        for name in sorted(os.listdir(folder)):
+            if name.endswith(".safetensors"):
+                path = os.path.join(folder, name)
+                h = safe_open(path, framework="np", device="cpu")
+                self._handles[path] = h
+                for key in h.keys():
+                    self._index[key] = path
+        if not self._handles:
+            raise SystemExit("Not found any model file")
+
+    def get(self, key: str) -> np.ndarray:
+        path = self._index.get(key)
+        if path is None:
+            raise SystemExit(f"Layer {key} not found")
+        t = self._handles[path].get_tensor(key)
+        if t.dtype == np.uint16:  # bfloat16 stored raw
+            import jax.numpy as jnp
+            t = np.asarray(jnp.asarray(t.view(jnp.bfloat16), jnp.float32))
+        return np.asarray(t, dtype=np.float32)
+
+
+def hf_source_name(our_name: str, spec: mfile.ModelSpec) -> tuple[str, bool]:
+    """Map a `.m` plan tensor name to its HF key; returns (key, permute?)."""
+    if our_name == "token_embedding":
+        return "model.embed_tokens.weight", False
+    if our_name == "rms_final":
+        return "model.norm.weight", False
+    if our_name == "wcls":
+        return "lm_head.weight", False
+    parts = our_name.split(".")
+    li = parts[1]
+    leaf = parts[-1]
+    base = f"model.layers.{li}"
+    if leaf == "wq":
+        return f"{base}.self_attn.q_proj.weight", True
+    if leaf == "wk":
+        return f"{base}.self_attn.k_proj.weight", True
+    if leaf == "wv":
+        return f"{base}.self_attn.v_proj.weight", False
+    if leaf == "wo":
+        return f"{base}.self_attn.o_proj.weight", False
+    if leaf == "rms_att":
+        return f"{base}.input_layernorm.weight", False
+    if leaf == "rms_ffn":
+        return f"{base}.post_attention_layernorm.weight", False
+    # dense FFN: w1=gate w2=down w3=up (convert-hf.py:77-83)
+    if leaf == "w1":
+        return f"{base}.mlp.gate_proj.weight", False
+    if leaf == "w2":
+        return f"{base}.mlp.down_proj.weight", False
+    if leaf == "w3":
+        return f"{base}.mlp.up_proj.weight", False
+    if parts[2] == "experts":
+        e = parts[3]
+        hf_leaf = {"up": "w3", "gate": "w1", "down": "w2"}[leaf]
+        return f"{base}.block_sparse_moe.experts.{e}.{hf_leaf}.weight", False
+    if leaf == "moe_router":
+        return f"{base}.block_sparse_moe.gate.weight", False
+    raise SystemExit(f"no HF mapping for {our_name}")
+
+
+def convert(folder: str, weights_ftype: int, out_path: str) -> None:
+    spec = load_spec(folder, weights_ftype)
+    store = SafetensorsStore(folder)
+    with mfile.MFileWriter(out_path, spec) as w:
+        for item in w.plan:
+            key, do_permute = hf_source_name(item.name, spec)
+            t = store.get(key)
+            if do_permute:
+                heads = spec.n_heads if item.name.endswith("wq") else spec.n_kv_heads
+                t = permute(t, spec.n_heads, heads)
+            print(f"🔶 Writing tensor {key} {tuple(t.shape)} -> {item.name}")
+            w.write_tensor(item.name, t.reshape(item.shape))
+    print(f"✅ {out_path} created successfully")
+
+
+def main(argv):
+    if len(argv) < 3:
+        print("Usage: python convert_hf.py <sourceFolderPath> <weightsFloatType> <name>")
+        raise SystemExit(1)
+    folder, ftype_name, name = argv[0], argv[1], argv[2]
+    ftype = quants.FLOAT_TYPE_BY_NAME[ftype_name]
+    out = f"dllama_model_{name}_{ftype_name}.m"
+    print(f"Output file: {out}")
+    convert(folder, ftype, out)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,110 @@
+"""Meta-checkpoint (`consolidated.*.pth`) → `.m` converter.
+
+Re-implements `/root/reference/converter/convert-llama.py`: a Meta Llama
+folder (``params.json`` + ``consolidated.NN.pth`` shards) becomes a `.m`
+file.  Multi-shard tensors are concatenated on the axis determined by the
+tensor kind (convert-llama.py:74-91): output-split tensors (wq/wk/wv/w1/w3/
+embedding/output) on axis 0, input-split tensors (wo/w2) on axis 1, norms
+taken from shard 0.  Meta checkpoints already use the interleaved RoPE
+layout, so no q/k permutation is needed (unlike convert_hf.py).
+
+Usage: python convert_llama.py <modelPath> <weightsFloatType>
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dllama_tpu import quants  # noqa: E402
+from dllama_tpu.io import mfile  # noqa: E402
+
+
+def load_spec(folder: str, weights_ftype: int, seq_len: int = 2048) -> mfile.ModelSpec:
+    with open(os.path.join(folder, "params.json")) as f:
+        params = json.load(f)
+    dim = params["dim"]
+    n_layers = params["n_layers"]
+    n_heads = params["n_heads"]
+    n_kv_heads = params.get("n_kv_heads", n_heads)
+    multiple_of = params.get("multiple_of", 256)
+    ffn_dim_multiplier = params.get("ffn_dim_multiplier")
+    # Meta's SwiGLU sizing rule (same derivation the reference relies on the
+    # checkpoint tensors for; needed here to pre-compute the plan)
+    hidden = 4 * dim
+    hidden = int(2 * hidden / 3)
+    if ffn_dim_multiplier is not None:
+        hidden = int(ffn_dim_multiplier * hidden)
+    hidden = multiple_of * ((hidden + multiple_of - 1) // multiple_of)
+    return mfile.ModelSpec(
+        arch=mfile.ARCH_LLAMA, dim=dim, hidden_dim=hidden, n_layers=n_layers,
+        n_heads=n_heads, n_kv_heads=n_kv_heads,
+        vocab_size=params.get("vocab_size", 32000) if params.get("vocab_size", -1) > 0 else 32000,
+        seq_len=seq_len, hidden_act=mfile.ACT_SILU,
+        rope_theta=float(params.get("rope_theta", 10000.0)),
+        weights_ftype=weights_ftype)
+
+
+# our name -> (meta key template, concat axis or None for shard-0-only)
+META_MAP = {
+    "token_embedding": ("tok_embeddings.weight", 1),  # embedding is column-split
+    "wq": ("layers.{l}.attention.wq.weight", 0),
+    "wk": ("layers.{l}.attention.wk.weight", 0),
+    "wv": ("layers.{l}.attention.wv.weight", 0),
+    "wo": ("layers.{l}.attention.wo.weight", 1),
+    "w1": ("layers.{l}.feed_forward.w1.weight", 0),
+    "w2": ("layers.{l}.feed_forward.w2.weight", 1),
+    "w3": ("layers.{l}.feed_forward.w3.weight", 0),
+    "rms_att": ("layers.{l}.attention_norm.weight", None),
+    "rms_ffn": ("layers.{l}.ffn_norm.weight", None),
+    "rms_final": ("norm.weight", None),
+    "wcls": ("output.weight", 0),
+}
+
+
+def convert(folder: str, weights_ftype: int, out_path: str, seq_len: int = 2048) -> None:
+    import torch
+
+    spec = load_spec(folder, weights_ftype, seq_len)
+    shard_paths = sorted(p for p in os.listdir(folder) if p.startswith("consolidated."))
+    if not shard_paths:
+        raise SystemExit("no consolidated.*.pth shards found")
+    shards = [torch.load(os.path.join(folder, p), map_location="cpu", mmap=True)
+              for p in shard_paths]
+
+    def get(name: str, layer: int | None) -> np.ndarray:
+        tmpl, axis = META_MAP[name]
+        key = tmpl.format(l=layer)
+        parts = [s[key].to(torch.float32).numpy() for s in shards]
+        if axis is None or len(parts) == 1:
+            return parts[0]
+        return np.concatenate(parts, axis=axis)
+
+    with mfile.MFileWriter(out_path, spec) as w:
+        for item in w.plan:
+            parts = item.name.split(".")
+            layer = int(parts[1]) if parts[0] == "layers" else None
+            leaf = parts[-1] if layer is not None else item.name
+            t = get(leaf, layer)
+            print(f"🔶 Writing tensor {item.name} {tuple(t.shape)}")
+            w.write_tensor(item.name, t.reshape(item.shape))
+    print(f"✅ {out_path} created successfully")
+
+
+def main(argv):
+    if len(argv) < 2:
+        print("Usage: python convert_llama.py <modelPath> <weightsFloatType>")
+        raise SystemExit(1)
+    folder, ftype_name = argv[0], argv[1]
+    name = os.path.basename(os.path.normpath(folder)).lower()
+    out = f"dllama_model_{name}_{ftype_name}.m"
+    convert(folder, quants.FLOAT_TYPE_BY_NAME[ftype_name], out)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,97 @@
+"""HF tokenizer folder → `.t` converter.
+
+Re-implements `/root/reference/converter/convert-tokenizer-hf.py`:
+* ``PreTrainedTokenizerFast`` — read ``tokenizer.json`` BPE vocab in id
+  order with score ``-id`` (convert-tokenizer-hf.py:20-39).
+* ``LlamaTokenizer`` — read ``tokenizer.model`` via sentencepiece, mapping
+  ``▁`` to space (convert-tokenizer-hf.py:41-55); gated on the
+  sentencepiece package being installed.
+
+Non-interactive: the reference prompts for an extra chat stop string on
+stdin; here it's the optional third argv.
+
+Usage: python convert_tokenizer_hf.py <tokenizerFolderPath> <name> [chatExtraStop]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dllama_tpu.io import tfile  # noqa: E402
+
+
+def open_json(path):
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def resolve_fast(dir_path: str, tokenizer_config: dict) -> tfile.TokenizerData:
+    tok = open_json(os.path.join(dir_path, "tokenizer.json"))
+    if tok["model"]["type"] != "BPE":
+        raise SystemExit("only BPE tokenizer.json is supported")
+    t = tfile.TokenizerData()
+    for token, tid in tok["model"]["vocab"].items():
+        if tid != len(t.vocab):
+            raise SystemExit("non-contiguous vocab ids")
+        t.vocab.append(token.encode("utf-8"))
+        t.scores.append(-float(tid))
+    for at in tok.get("added_tokens", []):
+        if at["id"] != len(t.vocab):
+            raise SystemExit("non-contiguous added_tokens ids")
+        t.vocab.append(at["content"].encode("utf-8"))
+        t.scores.append(-float(at["id"]))
+        if at["content"] == tokenizer_config.get("bos_token"):
+            t.bos_id = at["id"]
+        if at["content"] == tokenizer_config.get("eos_token"):
+            t.eos_id = at["id"]
+    return t
+
+
+def resolve_sentencepiece(dir_path: str) -> tfile.TokenizerData:
+    try:
+        from sentencepiece import SentencePieceProcessor
+    except ImportError:
+        raise SystemExit("sentencepiece is not installed in this environment; "
+                         "use a tokenizer.json-based folder instead")
+    sp = SentencePieceProcessor(model_file=os.path.join(dir_path, "tokenizer.model"))
+    t = tfile.TokenizerData(bos_id=sp.bos_id(), eos_id=sp.eos_id())
+    for i in range(sp.vocab_size()):
+        piece = sp.id_to_piece(i).replace("▁", " ")
+        t.vocab.append(piece.encode("utf-8"))
+        t.scores.append(sp.get_score(i))
+    return t
+
+
+def convert(dir_path: str, name: str, chat_extra_stop: str | None = None,
+            out_path: str | None = None) -> str:
+    cfg = open_json(os.path.join(dir_path, "tokenizer_config.json"))
+    cls = cfg.get("tokenizer_class")
+    if cls == "PreTrainedTokenizerFast":
+        t = resolve_fast(dir_path, cfg)
+    elif cls == "LlamaTokenizer":
+        t = resolve_sentencepiece(dir_path)
+    else:
+        raise SystemExit(f"Tokenizer {cls} is not supported")
+
+    t.chat_eos_id = t.eos_id
+    if "chat_template" in cfg:
+        t.chat_template = cfg["chat_template"]
+        t.chat_stop = chat_extra_stop
+    t.max_token_length = max((len(v) for v in t.vocab), default=0)
+
+    out = out_path or f"dllama_tokenizer_{name}.t"
+    print(f"bosId: {t.bos_id}  eosId: {t.eos_id}")
+    tfile.write_tfile(out, t)
+    print(f"✅ Created {out}")
+    return out
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 3:
+        print("Usage: python convert_tokenizer_hf.py <tokenizerFolderPath> <name> [chatExtraStop]")
+        raise SystemExit(1)
+    convert(sys.argv[1], sys.argv[2], sys.argv[3] if len(sys.argv) > 3 else None)

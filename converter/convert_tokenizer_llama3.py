@@ -1,0 +1,70 @@
+"""Llama-3 tiktoken tokenizer.model → `.t` converter.
+
+Re-implements `/root/reference/converter/convert-tokenizer-llama3.py`:
+the base64-per-line tiktoken vocab plus 256 hardcoded special tokens, the
+llama3 chat template, and the fixed bos/eos/chat-eos ids
+(convert-tokenizer-llama3.py:13-32).
+
+Usage: python convert_tokenizer_llama3.py <tokenizerPath>
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dllama_tpu.io import tfile  # noqa: E402
+
+N_SPECIAL_TOKENS = 256
+SPECIAL_TOKENS = [
+    "<|begin_of_text|>",
+    "<|end_of_text|>",
+    "<|reserved_special_token_0|>",
+    "<|reserved_special_token_1|>",
+    "<|reserved_special_token_2|>",
+    "<|reserved_special_token_3|>",
+    "<|start_header_id|>",
+    "<|end_header_id|>",
+    "<|reserved_special_token_4|>",
+    "<|eot_id|>",
+] + [f"<|reserved_special_token_{i}|>" for i in range(5, N_SPECIAL_TOKENS - 5)]
+
+BOS_ID = 128000
+EOS_ID = 128001
+CHAT_EOS_ID = 128009
+CHAT_TEMPLATE = (
+    "{% set loop_messages = messages %}{% for message in loop_messages %}"
+    "{% set content = '<|start_header_id|>' + message['role'] + '<|end_header_id|>\n\n'"
+    "+ message['content'] | trim + '<|eot_id|>' %}{% if loop.index0 == 0 %}"
+    "{% set content = bos_token + content %}{% endif %}{{ content }}{% endfor %}"
+    "{% if add_generation_prompt %}{{ '<|start_header_id|>assistant<|end_header_id|>\n\n' }}"
+    "{% endif %}")
+
+
+def convert(model_path: str, out_path: str = "dllama_tokenizer_llama3.t") -> str:
+    t = tfile.TokenizerData(bos_id=BOS_ID, eos_id=EOS_ID, chat_eos_id=CHAT_EOS_ID,
+                            chat_template=CHAT_TEMPLATE)
+    with open(model_path, "r") as f:
+        for line in f:
+            if not line.strip():
+                continue
+            b64, rank = line.split(" ")
+            t.vocab.append(base64.b64decode(b64))
+            t.scores.append(-float(rank))
+    for i, token in enumerate(SPECIAL_TOKENS):
+        t.vocab.append(token.encode("utf-8"))
+        t.scores.append(-float(len(t.vocab) - 1))
+    t.max_token_length = max(len(v) for v in t.vocab)
+    tfile.write_tfile(out_path, t)
+    print(f"✅ Created {out_path}")
+    return out_path
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 2:
+        print("Usage: python convert_tokenizer_llama3.py <tokenizerPath>")
+        raise SystemExit(1)
+    convert(sys.argv[1])

@@ -1,0 +1,203 @@
+"""`dllama` command-line app: inference | generate | chat | worker.
+
+Re-implements the reference app layer (`src/apps/dllama/dllama.cpp` +
+`src/app.cpp`) with the same flag surface (`AppArgs::parse`, app.cpp:19-93)
+and the same four modes (dllama.cpp:221-252):
+
+* ``inference`` — benchmark mode: per-token ``G/I/T`` ms line + run
+  averages (dllama.cpp:45-93 output contract).
+* ``generate``  — stream text for ``--steps`` tokens.
+* ``chat``      — REPL with system prompt, chat template, streaming EOS
+  detection, KV position persisting across turns (dllama.cpp:111-203).
+* ``worker``    — in the reference, a TCP worker process (dllama.cpp:205-
+  219).  On TPU the "workers" are mesh devices inside one process, so this
+  mode only explains the mapping and exits.
+
+``--workers`` keeps its name but takes ``tpu:N`` (a mesh degree) instead of
+host:port pairs — the transport is XLA collectives, not sockets.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+from . import quants
+from .io import mfile, tfile
+from .models.config import ModelConfig
+from .models.params import load_params
+from .parallel.mesh import parse_workers
+from .runtime.engine import Engine, RunStats
+from .sampling import Sampler
+from .tokenizer.bpe import Tokenizer
+from .tokenizer.chat import ChatItem, ChatTemplate, TokenizerChatStops
+from .tokenizer.eos import EOS, MAYBE_EOS, EosDetector
+
+DTYPES = {"f32": "float32", "bf16": "bfloat16", "f16": "float16"}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dllama", description=__doc__)
+    p.add_argument("mode", choices=["inference", "generate", "chat", "worker"])
+    p.add_argument("--model", help="path to .m model file")
+    p.add_argument("--tokenizer", help="path to .t tokenizer file")
+    p.add_argument("--prompt", default=None)
+    p.add_argument("--steps", type=int, default=0)
+    p.add_argument("--temperature", type=float, default=0.8)  # app.cpp:31
+    p.add_argument("--topp", type=float, default=0.9)         # app.cpp:32
+    p.add_argument("--seed", type=int, default=None)          # time-based default (app.cpp:33)
+    p.add_argument("--weights-float-type", choices=list(quants.FLOAT_TYPE_BY_NAME),
+                   default=None, help="required for legacy .m files without a header key")
+    p.add_argument("--buffer-float-type", choices=list(DTYPES), default="bf16",
+                   help="compute dtype (the reference's wire/buffer quantization analogue)")
+    p.add_argument("--workers", default=None, help="tpu:N mesh degree")
+    p.add_argument("--max-seq-len", type=int, default=None)
+    p.add_argument("--kv-cache-dtype", choices=list(DTYPES), default=None)
+    p.add_argument("--chunk", type=int, default=16, help="on-device decode chunk size")
+    p.add_argument("--nthreads", type=int, default=0, help="accepted for reference CLI parity; unused on TPU")
+    p.add_argument("--port", type=int, default=9990)
+    return p
+
+
+def load_stack(args) -> tuple[Engine, Tokenizer]:
+    import jax.numpy as jnp
+    if not args.model or not args.tokenizer:
+        raise SystemExit("--model and --tokenizer are required for this mode")
+    wft = quants.FLOAT_TYPE_BY_NAME[args.weights_float_type] if args.weights_float_type else None
+    mf = mfile.MFile(args.model, weights_ftype=wft)
+    dtype = jnp.dtype(DTYPES[args.buffer_float_type])
+    cfg = ModelConfig.from_spec(mf.spec, dtype=dtype)
+    print(f"💡 arch: {mf.spec.arch_name}")
+    print(f"💡 dim: {cfg.dim}\n💡 nLayers: {cfg.n_layers}\n💡 nHeads: {cfg.n_heads}")
+    print(f"💡 nKvHeads: {cfg.n_kv_heads}\n💡 vocabSize: {cfg.vocab_size}\n💡 seqLen: {cfg.seq_len}")
+    cfg, params = load_params(mf, cfg, dtype=dtype)
+    mesh = parse_workers(args.workers)
+    print(f"💡 mesh: tp={mesh.shape['tp']}")
+    kv_dtype = jnp.dtype(DTYPES[args.kv_cache_dtype]) if args.kv_cache_dtype else None
+    engine = Engine(cfg, params, mesh=mesh, seq_len=args.max_seq_len, kv_dtype=kv_dtype)
+    tok = Tokenizer(tfile.read_tfile(args.tokenizer))
+    if tok.vocab_size != cfg.vocab_size:
+        raise SystemExit("tokenizer is incompatible with model (vocab size mismatch)")
+    return engine, tok
+
+
+def _seed(args) -> int:
+    return args.seed if args.seed is not None else int(time.time())
+
+
+def cmd_inference(args) -> None:
+    """Benchmark mode (dllama.cpp:45-93): prints per-token G/I/T."""
+    engine, tok = load_stack(args)
+    prompt = args.prompt or "Hello world"
+    ids = tok.encode(prompt, add_bos=True)
+    steps = args.steps or 64
+    stats = RunStats()
+    pieces = []
+    prev = tok.bos_id
+    for token, st in engine.generate_stream(
+            ids, steps + len(ids), temperature=args.temperature, topp=args.topp,
+            seed=_seed(args), chunk=args.chunk):
+        piece = tok.decode_piece(prev, token).decode("utf-8", errors="replace")
+        prev = token
+        if st.generation_ms > 0:
+            stats.add(st)
+        print(f"🔶 G {st.generation_ms:7.2f} ms I {st.inference_ms:7.2f} ms "
+              f"T {st.transfer_ms:6.2f} ms | {piece!r}")
+        pieces.append(piece)
+    print(f"Generated tokens:    {len(stats.tokens)}")
+    print(f"Avg tokens / second: {stats.tokens_per_second:.2f}")
+    print(f"Avg generation time: {stats.avg_generation_ms:.2f} ms")
+    print(f"Avg inference time:  {stats.avg_inference_ms:.2f} ms")
+    print(f"Avg transfer time:   {stats.avg_transfer_ms:.2f} ms")
+
+
+def cmd_generate(args) -> None:
+    engine, tok = load_stack(args)
+    if args.prompt is None:
+        raise SystemExit("generate mode requires --prompt")
+    ids = tok.encode(args.prompt, add_bos=True)
+    steps = args.steps or engine.seq_len
+    prev = tok.bos_id
+    eos = (tok.eos_id,) if tok.eos_id >= 0 else ()
+    for token, _ in engine.generate_stream(
+            ids, steps, temperature=args.temperature, topp=args.topp,
+            seed=_seed(args), eos_ids=eos, chunk=args.chunk):
+        sys.stdout.write(tok.decode_piece(prev, token).decode("utf-8", errors="replace"))
+        sys.stdout.flush()
+        prev = token
+    print()
+
+
+def cmd_chat(args) -> None:
+    """Multi-turn REPL (dllama.cpp:111-203): one KV cache per conversation."""
+    engine, tok = load_stack(args)
+    stops = TokenizerChatStops(tok)
+    template = ChatTemplate(tok.chat_template, tok.vocab[tok.chat_eos_id].decode("utf-8", "replace"))
+    eos_detector = EosDetector(tok.chat_eos_id, stops.stops,
+                               padding_left=2, padding_right=2)  # dllama.cpp:198-199
+
+    print("💻 System prompt (optional): ", end="", flush=True)
+    system = sys.stdin.readline().strip()
+    first = True
+    while True:
+        print("\n👱 User\n> ", end="", flush=True)
+        user = sys.stdin.readline()
+        if not user:
+            break
+        user = user.strip()
+        if not user:
+            continue
+        items = []
+        if first and system:
+            items.append(ChatItem("system", system))
+        items.append(ChatItem("user", user))
+        first = False
+        text = template.generate(items, True)
+        ids = tok.encode(text, add_bos=engine.pos == 0)
+        if engine.pos + len(ids) + 2 >= engine.seq_len:
+            print("🚫 context window is full")
+            break
+        print("\n🤖 Assistant")
+        prev = tok.bos_id
+        eos_detector.clear()
+        n_prompt = len(ids)
+        budget = engine.seq_len - engine.pos
+        for i, (token, _) in enumerate(engine.generate_stream(
+                ids, budget, temperature=args.temperature, topp=args.topp,
+                seed=_seed(args), chunk=args.chunk)):
+            if i < n_prompt:
+                prev = token
+                continue
+            piece = tok.decode_piece(prev, token).decode("utf-8", errors="replace")
+            prev = token
+            res = eos_detector.append(token, piece)
+            if res == MAYBE_EOS:
+                continue  # hold back a potential partial stop string
+            delta = eos_detector.get_delta()
+            if delta:
+                sys.stdout.write(delta)
+                sys.stdout.flush()
+            eos_detector.clear()
+            if res == EOS:
+                break
+        print()
+
+
+def cmd_worker(args) -> None:
+    print("On this framework the reference's worker processes are TPU mesh devices\n"
+          "inside one program: run the root command with --workers tpu:N instead.\n"
+          "(reference: dllama.cpp:205-219 TCP worker; here the transport is XLA\n"
+          "collectives over ICI — see dllama_tpu/parallel/)")
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    {"inference": cmd_inference, "generate": cmd_generate,
+     "chat": cmd_chat, "worker": cmd_worker}[args.mode](args)
+
+
+if __name__ == "__main__":
+    main()

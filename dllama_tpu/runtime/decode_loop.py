@@ -16,8 +16,6 @@ host Sampler (sampling.py) remains available for bit-exact parity runs.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 

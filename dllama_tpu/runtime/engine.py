@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -131,9 +130,10 @@ class Engine:
             raise ValueError("empty prompt")
         if self.pos + n > self.seq_len:
             raise ValueError(f"prompt of {n} exceeds seq_len {self.seq_len} at pos {self.pos}")
-        bucket = min(_next_bucket(n), self.seq_len)
-        if bucket < n:
-            bucket = n
+        # the padded bucket must also fit the cache: dynamic_update_slice
+        # clamps out-of-range starts *backwards*, which would silently
+        # overwrite valid KV history near the end of context
+        bucket = max(n, min(_next_bucket(n), self.seq_len - self.pos))
         toks = np.zeros((self.batch, bucket), np.int32)
         toks[:, :n] = prompt_tokens
         logits, stats = self._run(toks, n - 1)
@@ -187,7 +187,9 @@ class Engine:
 
         sampler = Sampler(self.cfg.vocab_size, temperature, topp, seed)
         token = int(sampler.sample(logits[0]))
-        yield token, pstats
+        # prefill cost was already attributed to the last prompt token; this
+        # token only cost a host-side sample over fetched logits
+        yield token, StepStats()
         produced += 1
         if token in eos_ids:
             return
@@ -254,6 +256,7 @@ class Engine:
                 return
 
         token = int(sampler.sample(logits[0]))
+        stats = StepStats()  # prefill cost already attributed above
         while True:
             yield token, stats
             produced += 1

@@ -1,0 +1,284 @@
+"""OpenAI-compatible HTTP API server (`dllama-api` equivalent).
+
+Re-implements `/root/reference/src/apps/dllama-api/dllama-api.cpp`:
+
+* ``POST /v1/chat/completions`` — chat completion with optional SSE
+  streaming (writeChatCompletionChunk, :168-185), per-request temperature /
+  top_p / max_tokens / seed / stop (:351-380), usage counts (:336-345).
+* ``GET /v1/models`` — stub model list (:387-393).
+* **NaiveCache** (:187-232): if a new request's messages extend the cached
+  conversation prefix exactly, generation resumes from the cached KV
+  position instead of re-prefilling the whole history.
+
+Single-threaded request handling like the reference's accept loop
+(:418-429) — the engine owns one KV cache, so requests serialize.
+Uses only the standard library (the reference vendors nlohmann/json;
+Python's ``json`` plays that role).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from ..runtime.engine import Engine
+from ..tokenizer.bpe import Tokenizer
+from ..tokenizer.chat import ChatItem, ChatTemplate, TokenizerChatStops
+from ..tokenizer.eos import EOS, MAYBE_EOS, EosDetector
+
+
+@dataclass
+class ChatMessage:
+    role: str
+    content: str
+
+
+@dataclass
+class CacheItem:
+    end_pos: int
+    message: ChatMessage
+
+
+class NaiveCache:
+    """Longest-prefix conversation cache (dllama-api.cpp:187-232)."""
+
+    def __init__(self):
+        self.items: list[CacheItem] = []
+
+    def clear(self):
+        self.items.clear()
+
+    def push(self, end_pos: int, message: ChatMessage):
+        self.items.append(CacheItem(end_pos, message))
+
+    def resolve_delta_prompt(self, messages: list[ChatMessage]) -> tuple[int, list[ChatMessage]]:
+        """Returns (start_pos, delta_messages). On any mismatch the cache is
+        cleared and the full message list is returned with start_pos 0."""
+        n = len(self.items)
+        if n and len(messages) > n:
+            for i in range(n):
+                if (self.items[i].message.role != messages[i].role or
+                        self.items[i].message.content != messages[i].content):
+                    break
+            else:
+                start = self.items[n - 1].end_pos
+                return start, messages[n:]
+        self.clear()
+        return 0, messages
+
+
+@dataclass
+class InferenceParams:
+    messages: list[ChatMessage] = field(default_factory=list)
+    temperature: float = 0.7
+    top_p: float = 0.9
+    max_tokens: int = 0
+    stream: bool = False
+    seed: int | None = None
+    stop: list[str] = field(default_factory=list)
+
+
+def parse_request(body: dict, default_temp: float, default_topp: float) -> InferenceParams:
+    """Request-param extraction (dllama-api.cpp:351-380)."""
+    p = InferenceParams(temperature=default_temp, top_p=default_topp)
+    for m in body.get("messages", []):
+        p.messages.append(ChatMessage(str(m.get("role", "")), str(m.get("content", ""))))
+    if "temperature" in body:
+        p.temperature = float(body["temperature"])
+    if "top_p" in body:
+        p.top_p = float(body["top_p"])
+    if "max_tokens" in body:
+        p.max_tokens = int(body["max_tokens"])
+    if "stream" in body:
+        p.stream = bool(body["stream"])
+    if "seed" in body:
+        p.seed = int(body["seed"])
+    stop = body.get("stop")
+    if isinstance(stop, str):
+        p.stop = [stop]
+    elif isinstance(stop, list):
+        p.stop = [str(s) for s in stop]
+    return p
+
+
+class ApiState:
+    """Engine + tokenizer + conversation cache shared across requests."""
+
+    def __init__(self, engine: Engine, tokenizer: Tokenizer,
+                 default_temperature: float = 0.7, default_topp: float = 0.9,
+                 chunk: int = 16, model_name: str = "dllama-tpu"):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.default_temperature = default_temperature
+        self.default_topp = default_topp
+        self.chunk = chunk
+        self.model_name = model_name
+        self.naive_cache = NaiveCache()
+        stops = TokenizerChatStops(tokenizer)
+        self.base_stops = stops.stops
+        eos = tokenizer.vocab[tokenizer.chat_eos_id].decode("utf-8", "replace")
+        self.template = ChatTemplate(tokenizer.chat_template, eos)
+
+    # ------------------------------------------------------------------
+    def complete(self, params: InferenceParams, emit):
+        """Run one chat completion; calls ``emit(delta_text)`` as text becomes
+        safe to stream.  Returns (content, n_prompt_tokens, n_completion_tokens)."""
+        engine, tok = self.engine, self.tokenizer
+
+        start_pos, delta_messages = self.naive_cache.resolve_delta_prompt(params.messages)
+        if start_pos == 0:
+            engine.reset()
+        engine.pos = start_pos
+
+        items = [ChatItem(m.role, m.content) for m in delta_messages]
+        text = self.template.generate(items, True)
+        prompt_tokens = tok.encode(text, add_bos=start_pos == 0)
+        prompt_end = start_pos + len(prompt_tokens)
+
+        for m in delta_messages:
+            self.naive_cache.push(prompt_end, m)
+
+        max_pos = engine.seq_len
+        if params.max_tokens > 0:
+            max_pos = min(prompt_end + params.max_tokens, engine.seq_len)
+        budget = max_pos - start_pos
+
+        detector = EosDetector(tok.chat_eos_id, self.base_stops + params.stop,
+                               padding_left=2, padding_right=2)
+        seed = params.seed if params.seed is not None else int(time.time())
+
+        content = []
+        prev = tok.bos_id
+        n_completion = 0
+        stream = engine.generate_stream(
+            prompt_tokens, budget, temperature=params.temperature,
+            topp=params.top_p, seed=seed, chunk=self.chunk)
+        for i, (token, _) in enumerate(stream):
+            if i < len(prompt_tokens):
+                prev = token
+                continue
+            n_completion += 1
+            piece = tok.decode_piece(prev, token).decode("utf-8", errors="replace")
+            prev = token
+            res = detector.append(token, piece)
+            if res == MAYBE_EOS:
+                continue
+            delta = detector.get_delta()
+            if delta:
+                content.append(delta)
+                emit(delta)
+            detector.clear()
+            if res == EOS:
+                break
+
+        reply = "".join(content)
+        if engine.pos >= engine.seq_len:
+            self.naive_cache.clear()  # context exhausted (dllama-api.cpp:330-331)
+        else:
+            self.naive_cache.push(engine.pos, ChatMessage("assistant", reply))
+        return reply, len(prompt_tokens), n_completion
+
+
+def make_handler(state: ApiState):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *a):
+            print(f"🔷 {self.command} {self.path}")
+
+        def _json(self, code: int, obj: dict):
+            data = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/v1/models":
+                self._json(200, {"object": "list", "data": [{
+                    "id": state.model_name, "object": "model",
+                    "created": int(time.time()), "owned_by": "user"}]})
+            elif self.path in ("/health", "/healthz"):
+                self._json(200, {"status": "ok"})
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/v1/chat/completions":
+                self._json(404, {"error": "not found"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                params = parse_request(body, state.default_temperature, state.default_topp)
+                if not params.messages:
+                    self._json(400, {"error": "messages required"})
+                    return
+            except (ValueError, json.JSONDecodeError) as e:
+                self._json(400, {"error": f"bad request: {e}"})
+                return
+
+            created = int(time.time())
+            cid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
+            if params.stream:
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+
+                def emit(delta):
+                    chunk = {"id": cid, "object": "chat.completion.chunk",
+                             "created": created, "model": state.model_name,
+                             "choices": [{"index": 0, "delta": {"content": delta},
+                                          "finish_reason": None}]}
+                    self.wfile.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                    self.wfile.flush()
+
+                state.complete(params, emit)
+                final = {"id": cid, "object": "chat.completion.chunk",
+                         "created": created, "model": state.model_name,
+                         "choices": [{"index": 0, "delta": {}, "finish_reason": "stop"}]}
+                self.wfile.write(f"data: {json.dumps(final)}\n\n".encode())
+                self.wfile.write(b"data: [DONE]\n\n")
+                self.wfile.flush()
+            else:
+                reply, n_prompt, n_completion = state.complete(params, lambda d: None)
+                self._json(200, {
+                    "id": cid, "object": "chat.completion", "created": created,
+                    "model": state.model_name,
+                    "choices": [{"index": 0, "finish_reason": "stop",
+                                 "message": {"role": "assistant", "content": reply}}],
+                    "usage": {"prompt_tokens": n_prompt,
+                              "completion_tokens": n_completion,
+                              "total_tokens": n_prompt + n_completion}})
+
+    return Handler
+
+
+def serve(state: ApiState, host: str = "0.0.0.0", port: int = 9990):
+    server = HTTPServer((host, port), make_handler(state))
+    print(f"🔷 dllama-api listening on {host}:{port}")
+    server.serve_forever()
+
+
+def main(argv=None):
+    import sys
+
+    from ..cli import build_parser, load_stack
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # reuse the dllama flag surface; the server has no positional mode
+    args = build_parser().parse_args(["inference", *argv])
+    engine, tok = load_stack(args)
+    state = ApiState(engine, tok, default_temperature=args.temperature,
+                     default_topp=args.topp, chunk=args.chunk)
+    serve(state, port=args.port)
+
+
+if __name__ == "__main__":
+    import sys
+    main(sys.argv[1:])

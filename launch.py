@@ -1,0 +1,87 @@
+"""Model-zoo launcher.
+
+Re-implements `/root/reference/launch.py`: downloads a converted `.m`/`.t`
+pair from the model zoo and writes a ready-to-run script.  Same model list
+(launch.py:6-22); the generated run command targets the TPU mesh
+(``--workers tpu:N``) instead of spawning TCP workers.
+
+Note: this build environment has zero network egress — downloads will fail
+here, but the tool is part of the capability surface and works wherever the
+zoo is reachable.
+
+Usage: python launch.py <model-name> [--tp N]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import urllib.request
+
+# [model-url, tokenizer-url, weights-float-type, buffer-float-type, model-type]
+MODELS = {
+    "tinyllama_1_1b_3t_q40": [
+        "https://huggingface.co/b4rtaz/TinyLlama-1.1B-3T-Distributed-Llama/resolve/main/dllama_model_tinylama_1.1b_3t_q40.m?download=true",
+        "https://huggingface.co/b4rtaz/TinyLlama-1.1B-3T-Distributed-Llama/resolve/main/dllama_tokenizer_tinylama_1.1b_3t.t?download=true",
+        "q40", "q80", "base",
+    ],
+    "llama3_8b_q40": [
+        "https://huggingface.co/b4rtaz/Llama-3-8B-Q40-Distributed-Llama/resolve/main/dllama_model_meta-llama-3-8b_q40.m?download=true",
+        "https://huggingface.co/b4rtaz/Llama-3-8B-Q40-Distributed-Llama/resolve/main/dllama_tokenizer_llama3.t?download=true",
+        "q40", "q80", "base",
+    ],
+    "llama3_8b_instruct_q40": [
+        "https://huggingface.co/b4rtaz/Llama-3-8B-Q40-Instruct-Distributed-Llama/resolve/main/dllama_model_lama3_instruct_q40.m?download=true",
+        "https://huggingface.co/b4rtaz/Llama-3-8B-Q40-Instruct-Distributed-Llama/resolve/main/dllama_tokenizer_llama3.t?download=true",
+        "q40", "q80", "chat",
+    ],
+}
+
+
+def download_file(url: str, path: str) -> None:
+    if os.path.isfile(path):
+        print(f"📄 {os.path.basename(path)} already exists, skipping")
+        return
+    print(f"📄 {url}")
+    with urllib.request.urlopen(url) as r, open(path, "wb") as f:
+        while True:
+            chunk = r.read(1 << 16)
+            if not chunk:
+                break
+            f.write(chunk)
+            size = f.tell() // 1024
+            sys.stdout.write(f"\rDownloaded {size} kB")
+    sys.stdout.write(" ✅\n")
+
+
+def launch(name: str, tp: int = 1) -> None:
+    if name not in MODELS:
+        raise SystemExit(f"unknown model {name}; available: {', '.join(MODELS)}")
+    model = MODELS[name]
+    dir_path = os.path.join("models", name)
+    os.makedirs(dir_path, exist_ok=True)
+    model_path = os.path.join(dir_path, f"dllama_model_{name}.m")
+    tok_path = os.path.join(dir_path, f"dllama_tokenizer_{name}.t")
+    download_file(model[0], model_path)
+    download_file(model[1], tok_path)
+
+    mode = "chat" if model[4] == "chat" else "inference"
+    command = (f"python -m dllama_tpu {mode} --model {model_path} "
+               f"--tokenizer {tok_path} --buffer-float-type bf16 "
+               f"--workers tpu:{tp}")
+    run_path = f"run_{name}.sh"
+    with open(run_path, "w") as f:
+        f.write(f"#!/bin/sh\n\n{command}\n")
+    os.chmod(run_path, 0o755)
+    print(f"🚀 Created {run_path}:\n   {command}")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
+        print(__doc__)
+        print("Available models:\n  " + "\n  ".join(MODELS))
+        raise SystemExit(0 if len(sys.argv) > 1 else 1)
+    tp_arg = 1
+    if "--tp" in sys.argv:
+        tp_arg = int(sys.argv[sys.argv.index("--tp") + 1])
+    launch(sys.argv[1], tp_arg)

@@ -1,0 +1,75 @@
+"""Shared fixture builders: tiny `.m`/`.t` files usable end-to-end
+(CLI/API subprocess tests) — the analogue of the reference's generated
+xorshift weight fixtures (llama2-tasks-test.cpp:556-562)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from dllama_tpu import quants
+from dllama_tpu.io import mfile, tfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHATML_JINJA = "{% for message in messages %}<|im_start|>...jinja...{% endfor %}"
+
+
+def write_tiny_model(path, *, arch=mfile.ARCH_LLAMA, ftype=quants.Q80,
+                     vocab_size=300, n_experts=0, seq_len=128, seed=0) -> mfile.ModelSpec:
+    spec = mfile.ModelSpec(
+        arch=arch, dim=64, hidden_dim=96, n_layers=2, n_heads=4, n_kv_heads=2,
+        n_experts=n_experts, n_active_experts=2 if n_experts else 0,
+        vocab_size=vocab_size, seq_len=seq_len, hidden_act=mfile.ACT_SILU,
+        rope_theta=10000.0, weights_ftype=ftype)
+    rng = np.random.RandomState(seed)
+    with mfile.MFileWriter(path, spec) as w:
+        for t in w.plan:
+            w.write_tensor(t.name, (rng.randn(*t.shape) * 0.05).astype(np.float32))
+    return spec
+
+
+def write_tiny_tokenizer(path, vocab_size=300) -> tfile.TokenizerData:
+    """Vocab: 3 specials (+ 256 byte tokens when it fits) + a few words;
+    chatml template.  Small vocab sizes skip the byte-fallback pieces."""
+    vocab = [b"<unk>", b"<s>", b"</s>"]
+    words = [b" ", b"a", b"b", b"e", b"h", b"i", b"l", b"o", b"he", b"ll",
+             b"hell", b"hello", b"hi", b" hi", b" hello",
+             b"<|im_end|>", b"<|im_start|>"]
+    if vocab_size >= 3 + 256 + len(words):
+        vocab += [f"<0x{i:02X}>".encode() for i in range(256)]
+    vocab += words
+    if len(vocab) > vocab_size:
+        raise ValueError(f"vocab_size {vocab_size} too small for fixture")
+    while len(vocab) < vocab_size:
+        vocab.append(f"<extra_{len(vocab)}>".encode())
+    scores = [float(len(v)) if v in words else 0.0 for v in vocab]
+    t = tfile.TokenizerData(
+        vocab=vocab, scores=scores, bos_id=1, eos_id=2,
+        chat_eos_id=vocab.index(b"<|im_end|>"),
+        chat_template=CHATML_JINJA, chat_stop=None)
+    tfile.write_tfile(path, t)
+    return t
+
+
+def cpu_env(n_devices: int = 1) -> dict:
+    """Subprocess env that actually selects the CPU backend: the axon
+    sitecustomize only registers the TPU when PALLAS_AXON_POOL_IPS is set,
+    so blanking it lets JAX_PLATFORMS=cpu take effect."""
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        f" --xla_force_host_platform_device_count={n_devices}").strip()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def run_cli(args: list[str], *, input_text: str | None = None, n_devices: int = 1,
+            timeout: int = 240) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "dllama_tpu", *args], cwd=REPO, env=cpu_env(n_devices),
+        input=input_text, capture_output=True, text=True, timeout=timeout)

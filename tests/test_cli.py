@@ -1,0 +1,84 @@
+"""CLI subprocess tests: the four dllama modes driven end-to-end on tiny
+fixture models (reference modes: dllama.cpp:221-252)."""
+
+import pytest
+
+from fixtures import run_cli, write_tiny_model, write_tiny_tokenizer
+
+
+@pytest.fixture(scope="module")
+def model_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cli")
+    m = d / "tiny.m"
+    t = d / "tiny.t"
+    write_tiny_model(m)
+    write_tiny_tokenizer(t)
+    return str(m), str(t)
+
+
+def test_inference_mode_prints_stats(model_files):
+    m, t = model_files
+    r = run_cli(["inference", "--model", m, "--tokenizer", t,
+                 "--prompt", "hello", "--steps", "8", "--temperature", "0"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "Avg tokens / second:" in r.stdout
+    assert "Avg generation time:" in r.stdout
+    assert "🔶 G" in r.stdout
+    assert "💡 arch: llama" in r.stdout
+
+
+def test_generate_mode_streams_text(model_files):
+    m, t = model_files
+    r = run_cli(["generate", "--model", m, "--tokenizer", t,
+                 "--prompt", "hello", "--steps", "10", "--temperature", "0", "--seed", "1"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert len(r.stdout.strip()) > 0
+
+
+def test_generate_deterministic_greedy(model_files):
+    m, t = model_files
+    args = ["generate", "--model", m, "--tokenizer", t, "--prompt", "hello",
+            "--steps", "10", "--temperature", "0"]
+    a, b = run_cli(args), run_cli(args)
+    assert a.stdout == b.stdout
+
+
+def test_generate_requires_prompt(model_files):
+    m, t = model_files
+    r = run_cli(["generate", "--model", m, "--tokenizer", t])
+    assert r.returncode != 0
+    assert "--prompt" in r.stderr
+
+
+def test_chat_mode_one_turn(model_files):
+    m, t = model_files
+    r = run_cli(["chat", "--model", m, "--tokenizer", t, "--temperature", "0",
+                 "--steps", "16"], input_text="sys prompt\nhello\n")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "🤖 Assistant" in r.stdout
+
+
+def test_worker_mode_explains_mapping(model_files):
+    r = run_cli(["worker"])
+    assert r.returncode == 0
+    assert "tpu:N" in r.stdout
+
+
+def test_missing_model_flag_errors():
+    r = run_cli(["inference"])
+    assert r.returncode != 0
+    assert "--model" in r.stderr
+
+
+def test_tp4_workers_flag(model_files):
+    m, t = model_files
+    # nKvHeads=2 caps tp at 2 (reference constraint) — tpu:2 must work
+    r = run_cli(["generate", "--model", m, "--tokenizer", t, "--prompt", "hello",
+                 "--steps", "6", "--temperature", "0", "--workers", "tpu:2"],
+                n_devices=2)
+    assert r.returncode == 0, r.stderr[-2000:]
+    # and tpu:4 must refuse with the reference's nKvHeads error
+    r4 = run_cli(["generate", "--model", m, "--tokenizer", t, "--prompt", "hello",
+                  "--steps", "6", "--workers", "tpu:4"], n_devices=4)
+    assert r4.returncode != 0
+    assert "nKvHeads" in r4.stderr

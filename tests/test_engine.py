@@ -87,6 +87,18 @@ def test_reset_restarts_sequence():
     np.testing.assert_allclose(l1, l2, atol=1e-5)
 
 
+def test_prefill_bucket_never_overflows_cache():
+    """Regression: a padded prefill bucket near the end of context must not
+    exceed the cache — dynamic_update_slice clamps out-of-range starts
+    backwards, silently overwriting valid KV history."""
+    e = make_engine()
+    e.prefill(list(range(1, 21)))  # pos=20 of seq_len=32
+    l_cont, _ = e.prefill([21, 22, 23, 24, 25])  # bucket must cap at 12, not 16
+    e2 = make_engine()
+    l_full, _ = e2.prefill(list(range(1, 26)))
+    np.testing.assert_allclose(l_cont, l_full, atol=1e-4, rtol=1e-3)
+
+
 def test_multi_turn_kv_continuity():
     """Chat-style incremental prefill: a second prefill continues the same
     KV sequence (dllama.cpp:111-203 chat mode keeps pos across turns)."""
