@@ -57,6 +57,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-seq-len", type=int, default=None)
     p.add_argument("--kv-cache-dtype", choices=list(DTYPES), default=None)
     p.add_argument("--chunk", type=int, default=16, help="on-device decode chunk size")
+    p.add_argument("--dequantize", action="store_true",
+                   help="load Q40 weights as dense bf16 instead of the packed "
+                        "fused-kernel path (debugging / numerics comparison)")
     p.add_argument("--nthreads", type=int, default=0, help="accepted for reference CLI parity; unused on TPU")
     p.add_argument("--port", type=int, default=9990)
     return p
@@ -73,7 +76,8 @@ def load_stack(args) -> tuple[Engine, Tokenizer]:
     print(f"💡 arch: {mf.spec.arch_name}")
     print(f"💡 dim: {cfg.dim}\n💡 nLayers: {cfg.n_layers}\n💡 nHeads: {cfg.n_heads}")
     print(f"💡 nKvHeads: {cfg.n_kv_heads}\n💡 vocabSize: {cfg.vocab_size}\n💡 seqLen: {cfg.seq_len}")
-    cfg, params = load_params(mf, cfg, dtype=dtype)
+    cfg, params = load_params(mf, cfg, dtype=dtype,
+                              keep_quantized=not args.dequantize)
     mesh = parse_workers(args.workers)
     print(f"💡 mesh: tp={mesh.shape['tp']}")
     kv_dtype = jnp.dtype(DTYPES[args.kv_cache_dtype]) if args.kv_cache_dtype else None
@@ -164,13 +168,18 @@ def cmd_chat(args) -> None:
         prev = tok.bos_id
         eos_detector.clear()
         n_prompt = len(ids)
+        prompt_end = engine.pos + n_prompt
         budget = engine.seq_len - engine.pos
+        n_completion = 0
+        ended_by_eos = False
         for i, (token, _) in enumerate(engine.generate_stream(
                 ids, budget, temperature=args.temperature, topp=args.topp,
-                seed=_seed(args), chunk=args.chunk)):
+                seed=_seed(args), chunk=args.chunk,
+                eos_ids=(tok.chat_eos_id,))):
             if i < n_prompt:
                 prev = token
                 continue
+            n_completion += 1
             piece = tok.decode_piece(prev, token).decode("utf-8", errors="replace")
             prev = token
             res = eos_detector.append(token, piece)
@@ -182,7 +191,16 @@ def cmd_chat(args) -> None:
                 sys.stdout.flush()
             eos_detector.clear()
             if res == EOS:
+                ended_by_eos = True
                 break
+        if not ended_by_eos:
+            delta = eos_detector.get_delta()  # flush held-back partial match
+            if delta:
+                sys.stdout.write(delta)
+                sys.stdout.flush()
+        # drop chunk-overshoot KV so the next turn prefills at the real end
+        # of this reply (generate_stream only rewinds for eos_ids itself)
+        engine.pos = min(engine.pos, prompt_end + n_completion)
         print()
 
 

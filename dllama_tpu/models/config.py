@@ -35,6 +35,12 @@ class ModelConfig:
     hidden_act: int
     rope_theta: float
     dtype: jnp.dtype = jnp.float32
+    # matmul implementation for Q40-quantized weights: "pallas" (fused
+    # kernel, single-chip), "xla" (partitionable emulation, used under TP
+    # sharding and on CPU), or "auto" (pallas on TPU for decode-sized
+    # inputs, xla otherwise).  Static so each choice compiles its own
+    # program.
+    quant_impl: str = "auto"
 
     @property
     def head_size(self) -> int:

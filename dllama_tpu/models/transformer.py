@@ -29,6 +29,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..ops import q40
 from ..ops.attention import gqa_attention, update_kv_cache
 from ..ops.kernels import ACTIVATIONS, apply_rope, rmsnorm, rope_angles, softmax_f32
 from .config import ModelConfig
@@ -54,14 +55,21 @@ def init_kv_cache(cfg: ModelConfig, batch: int, seq_len: int | None = None,
     return KVCache(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
 
 
+def _mm(x, w, cfg: ModelConfig):
+    """Matmul that accepts dense arrays or packed Q40 weights.  Weight
+    dtype/format is a per-tensor property (the reference likewise
+    dispatches per weight dtype, funcs.cpp:414-455)."""
+    return q40.mm(x, w, impl=cfg.quant_impl).astype(cfg.dtype)
+
+
 def _attention_block(x, lp, cfg: ModelConfig, k_cache, v_cache, cos, sin, pos):
     b, t, d = x.shape
     hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_size
 
     xb = rmsnorm(x, lp["rms_att"])
-    q = (xb @ lp["wq"]).reshape(b, t, hq, dh)
-    k = (xb @ lp["wk"]).reshape(b, t, hkv, dh)
-    v = (xb @ lp["wv"]).reshape(b, t, hkv, dh)
+    q = _mm(xb, lp["wq"], cfg).reshape(b, t, hq, dh)
+    k = _mm(xb, lp["wk"], cfg).reshape(b, t, hkv, dh)
+    v = _mm(xb, lp["wv"], cfg).reshape(b, t, hkv, dh)
 
     q = apply_rope(q, cos, sin, interleaved=cfg.rope_interleaved)
     k = apply_rope(k, cos, sin, interleaved=cfg.rope_interleaved)
@@ -73,14 +81,14 @@ def _attention_block(x, lp, cfg: ModelConfig, k_cache, v_cache, cos, sin, pos):
 
     att = gqa_attention(q, k_cache, v_cache, pos, t)
     att = att.transpose(0, 2, 1, 3).reshape(b, t, hq * dh)
-    out = att @ lp["wo"]  # col-sharded: XLA all-reduces the partial sums here
+    out = _mm(att, lp["wo"], cfg)  # col-sharded: XLA all-reduces the partial sums here
     return out, k_cache, v_cache
 
 
 def _dense_ffn(xb, lp, cfg: ModelConfig):
     act = ACTIVATIONS[cfg.hidden_act]
-    h = act(xb @ lp["w1"]) * (xb @ lp["w3"])
-    return h @ lp["w2"]
+    h = act(_mm(xb, lp["w1"], cfg)) * _mm(xb, lp["w3"], cfg)
+    return _mm(h, lp["w2"], cfg)
 
 
 def moe_ffn(xb2d: jax.Array, lp, cfg: ModelConfig) -> jax.Array:
@@ -165,7 +173,9 @@ def run_blocks(params: Params, cfg: ModelConfig, tokens: jax.Array,
 
 def _head(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
     x = rmsnorm(x, params["rms_final"])
-    logits = (x @ params["wcls"]).astype(jnp.float32)
+    # out_dtype=f32 keeps the matmul's f32 accumulation for the sampler
+    # instead of a round trip through the bf16 activation dtype
+    logits = q40.mm(x, params["wcls"], impl=cfg.quant_impl, out_dtype=jnp.float32)
     if cfg.logit_scale != 1.0:
         logits = logits * cfg.logit_scale
     return logits

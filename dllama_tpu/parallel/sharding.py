@@ -102,6 +102,14 @@ def place_params(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
     This replaces the reference's weight-distribution phase
     (``loadRoot`` streaming slices over sockets, transformer.cpp:389-404):
     `jax.device_put` slices each array and uploads only each chip's shard.
+
+    Packed Q40 weights (ops/q40.py QTensor) shard with the *same* spec as
+    their dense counterpart: the block-local nibble layout keeps every
+    32-row quantization block on one shard, so slicing the packed array's
+    row axis at 1/tp is exactly the reference's ``splitWeights`` on the
+    quantized bytes (commands.cpp:19-36).  ``jax.device_put`` applies the
+    sharding to both pytree leaves (qpacked + scales, whose row counts are
+    N/2 and N/32 — both divisible at block granularity).
     """
     shardings = param_shardings(cfg, mesh)
     return {k: jax.device_put(v, shardings[k]) for k, v in params.items()}

@@ -81,13 +81,20 @@ class Engine:
 
     def __init__(self, cfg: ModelConfig, params: Params, mesh=None,
                  batch: int = 1, seq_len: int | None = None, kv_dtype=None):
-        self.cfg = cfg
         self.batch = batch
         self.seq_len = min(seq_len or cfg.seq_len, cfg.seq_len)
         self.mesh = mesh if mesh is not None else make_mesh(tp=1, devices=jax.devices()[:1])
         tp = self.mesh.shape.get("tp", 1)
         if tp > 1:
             sharding.check_tp_constraint(cfg, tp)
+        # Packed-Q40 matmul dispatch: the fused Pallas kernel is a single-
+        # device program (GSPMD cannot partition a pallas_call), so under a
+        # tp>1 mesh force the partitionable XLA emulation; a caller's
+        # explicit single-chip choice (e.g. "xla" for numerics debugging)
+        # is respected.
+        if tp > 1 and cfg.quant_impl in ("auto", "pallas"):
+            cfg = cfg.with_(quant_impl="xla")
+        self.cfg = cfg
         self.params = sharding.place_params(params, cfg, self.mesh)
         self.cache = jax.device_put(
             init_kv_cache(cfg, batch, self.seq_len, dtype=kv_dtype),

@@ -82,19 +82,20 @@ class InferenceParams:
 
 
 def parse_request(body: dict, default_temp: float, default_topp: float) -> InferenceParams:
-    """Request-param extraction (dllama-api.cpp:351-380)."""
+    """Request-param extraction (dllama-api.cpp:351-380).  JSON ``null``
+    for an optional field means "unset" to most OpenAI clients."""
     p = InferenceParams(temperature=default_temp, top_p=default_topp)
     for m in body.get("messages", []):
         p.messages.append(ChatMessage(str(m.get("role", "")), str(m.get("content", ""))))
-    if "temperature" in body:
+    if body.get("temperature") is not None:
         p.temperature = float(body["temperature"])
-    if "top_p" in body:
+    if body.get("top_p") is not None:
         p.top_p = float(body["top_p"])
-    if "max_tokens" in body:
+    if body.get("max_tokens") is not None:
         p.max_tokens = int(body["max_tokens"])
-    if "stream" in body:
+    if body.get("stream") is not None:
         p.stream = bool(body["stream"])
-    if "seed" in body:
+    if body.get("seed") is not None:
         p.seed = int(body["seed"])
     stop = body.get("stop")
     if isinstance(stop, str):
@@ -137,6 +138,11 @@ class ApiState:
         text = self.template.generate(items, True)
         prompt_tokens = tok.encode(text, add_bos=start_pos == 0)
         prompt_end = start_pos + len(prompt_tokens)
+        if prompt_end + 1 >= engine.seq_len:
+            # refuse before touching the cache — a poisoned entry would make
+            # every follow-up request resolve to a bogus start_pos
+            raise ValueError(
+                f"prompt needs {prompt_end} of {engine.seq_len} context positions")
 
         for m in delta_messages:
             self.naive_cache.push(prompt_end, m)
@@ -155,7 +161,9 @@ class ApiState:
         n_completion = 0
         stream = engine.generate_stream(
             prompt_tokens, budget, temperature=params.temperature,
-            topp=params.top_p, seed=seed, chunk=self.chunk)
+            topp=params.top_p, seed=seed, chunk=self.chunk,
+            eos_ids=(tok.chat_eos_id,))
+        ended_by_eos = False
         for i, (token, _) in enumerate(stream):
             if i < len(prompt_tokens):
                 prev = token
@@ -172,7 +180,18 @@ class ApiState:
                 emit(delta)
             detector.clear()
             if res == EOS:
+                ended_by_eos = True
                 break
+        if not ended_by_eos:
+            # budget exhausted with a partial stop-string match held back —
+            # it was real text, flush it
+            delta = detector.get_delta()
+            if delta:
+                content.append(delta)
+                emit(delta)
+        # discard chunk-overshoot KV: tokens sampled past a stop string were
+        # never part of the reply, and must not condition later turns
+        engine.pos = min(engine.pos, prompt_end + n_completion)
 
         reply = "".join(content)
         if engine.pos >= engine.seq_len:
@@ -218,7 +237,7 @@ def make_handler(state: ApiState):
                 if not params.messages:
                     self._json(400, {"error": "messages required"})
                     return
-            except (ValueError, json.JSONDecodeError) as e:
+            except (TypeError, ValueError, json.JSONDecodeError) as e:
                 self._json(400, {"error": f"bad request: {e}"})
                 return
 
@@ -239,7 +258,11 @@ def make_handler(state: ApiState):
                     self.wfile.write(f"data: {json.dumps(chunk)}\n\n".encode())
                     self.wfile.flush()
 
-                state.complete(params, emit)
+                try:
+                    state.complete(params, emit)
+                except ValueError as e:  # headers already sent: error event
+                    self.wfile.write(
+                        f"data: {json.dumps({'error': str(e)})}\n\n".encode())
                 final = {"id": cid, "object": "chat.completion.chunk",
                          "created": created, "model": state.model_name,
                          "choices": [{"index": 0, "delta": {}, "finish_reason": "stop"}]}
@@ -247,7 +270,11 @@ def make_handler(state: ApiState):
                 self.wfile.write(b"data: [DONE]\n\n")
                 self.wfile.flush()
             else:
-                reply, n_prompt, n_completion = state.complete(params, lambda d: None)
+                try:
+                    reply, n_prompt, n_completion = state.complete(params, lambda d: None)
+                except ValueError as e:
+                    self._json(400, {"error": str(e)})
+                    return
                 self._json(200, {
                     "id": cid, "object": "chat.completion", "created": created,
                     "model": state.model_name,
