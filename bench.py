@@ -4,16 +4,19 @@ Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N}
 
 Mirrors the reference's benchmark mode (`dllama inference`,
-dllama.cpp:45-93): average per-token generation time over nSamples decode
-steps after prefill.  Baseline for comparison is the reference's best
-published single-node Llama-2-7B number — 101.81 ms/token (9.82 tok/s) on a
-c3d-highcpu-30 VM (README.md:126, BASELINE.md) — since multi-chip hardware
-is not reachable from this harness (one v5e chip via the axon tunnel).
+dllama.cpp:45-93): average per-token generation time over a decode loop.
+Baseline for comparison is the reference's best published single-node
+Llama-2-7B Q40 number — 101.81 ms/token (9.82 tok/s) on a c3d-highcpu-30
+VM (README.md:126, BASELINE.md) — since multi-chip hardware is not
+reachable from this harness (one v5e chip via the axon tunnel).
 
-Weights are zero-initialized on device: dense decode timing is
-value-independent, and materializing 7B random f32 weights on host would
-need ~27 GB RAM.  Falls back to TinyLlama-1.1B shapes if the 7B working set
-does not fit the chip.
+The benched path is the production one: packed-Q40 weights in HBM, the
+fused Pallas dequant-matmul (ops/q40.py), and the on-device K-step
+generation loop (runtime/decode_loop.py) — sampling included, only token
+ids cross to the host.  Weights are zero-valued (built directly as packed
+buffers): decode timing is value-independent, and materializing 7B f32
+weights on host would need ~27 GB RAM.  Falls back to TinyLlama-1.1B
+shapes if the 7B working set does not fit the chip.
 """
 
 from __future__ import annotations
@@ -29,7 +32,7 @@ import numpy as np
 
 def model_cfgs():
     from dllama_tpu.models.config import tiny_config
-    # llama-2-7b shapes (README.md:102 measurement target), short KV budget
+    # llama-2-7b shapes (README.md:102/126 measurement target)
     llama7b = tiny_config(dim=4096, hidden_dim=11008, n_layers=32, n_heads=32,
                           n_kv_heads=32, vocab_size=32000, seq_len=1024,
                           dtype=jnp.bfloat16)
@@ -40,15 +43,39 @@ def model_cfgs():
     return [("llama2-7b", llama7b, 9.82), ("tinyllama-1.1b", tiny11, None)]
 
 
-def bench_decode(cfg, chunk=32, n_chunks=4):
-    """Times the production path: the on-device K-step generation loop
-    (runtime/decode_loop.py) — sampling included, only token ids fetched."""
+def zero_q40_params(cfg):
+    """Params with packed-Q40 matmul weights, built as zero device buffers
+    (no host-side f32 materialization)."""
     from dllama_tpu.models.params import param_shapes
+    from dllama_tpu.ops.q40 import QTensor, padded_n
+
+    shapes = dict(param_shapes(cfg))
+    L, D = cfg.n_layers, cfg.dim
+    # fused projection layout, as the quantized loader produces
+    shapes["wqkv"] = (L, D, (cfg.n_heads + 2 * cfg.n_kv_heads) * cfg.head_size)
+    shapes["w13"] = (L, D, 2 * cfg.hidden_dim)
+    for k in ("wq", "wk", "wv", "w1", "w3"):
+        del shapes[k]
+
+    qkeys = {"wqkv", "wo", "w13", "w2", "wcls"}
+    params = {}
+    for k, shape in shapes.items():
+        if k in qkeys:
+            *lead, n, d = shape
+            np_ = padded_n(n)
+            params[k] = QTensor(
+                jnp.zeros((*lead, np_ // 2, d), jnp.uint8),
+                jnp.zeros((*lead, np_ // 32, d), jnp.float32), (n, d))
+        else:
+            params[k] = jnp.zeros(shape, jnp.float32 if k.startswith("rms") else cfg.dtype)
+    return params
+
+
+def bench_decode(cfg, chunk=64, n_chunks=4):
     from dllama_tpu.models.transformer import init_kv_cache
     from dllama_tpu.runtime.decode_loop import decode_chunk
 
-    params = {k: jnp.zeros(s, jnp.float32 if k.startswith("rms") else cfg.dtype)
-              for k, s in param_shapes(cfg).items()}
+    params = zero_q40_params(cfg)
     cache = init_kv_cache(cfg, batch=1)
 
     fn = jax.jit(
@@ -65,7 +92,7 @@ def bench_decode(cfg, chunk=32, n_chunks=4):
     for i in range(n_chunks):
         t0 = time.perf_counter()
         toks, cache, tok, _, _ = fn(params, cache, tok, jnp.int32((i + 1) * chunk), key)
-        np.asarray(toks)  # only K int32 ids cross the host boundary
+        np.asarray(toks)  # forces execution; only K int32 ids cross the boundary
         times.append((time.perf_counter() - t0) * 1000 / chunk)
     return float(np.mean(times))
 
@@ -80,7 +107,7 @@ def main():
             # model; the fallback has none, so its vs_baseline is null
             vs = round(toks / baseline_toks, 2) if baseline_toks else None
             print(json.dumps({
-                "metric": f"{name} bf16 decode tok/s (1 TPU v5e chip)",
+                "metric": f"{name} q40 decode tok/s (1 TPU v5e chip, fused pallas)",
                 "value": round(toks, 2),
                 "unit": "tok/s",
                 "vs_baseline": vs,

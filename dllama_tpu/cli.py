@@ -31,10 +31,11 @@ from .models.config import ModelConfig
 from .models.params import load_params
 from .parallel.mesh import parse_workers
 from .runtime.engine import Engine, RunStats
+from .runtime.stream import drain_generation
 from .sampling import Sampler
 from .tokenizer.bpe import Tokenizer
 from .tokenizer.chat import ChatItem, ChatTemplate, TokenizerChatStops
-from .tokenizer.eos import EOS, MAYBE_EOS, EosDetector
+from .tokenizer.eos import EosDetector
 
 DTYPES = {"f32": "float32", "bf16": "bfloat16", "f16": "float16"}
 
@@ -76,10 +77,13 @@ def load_stack(args) -> tuple[Engine, Tokenizer]:
     print(f"💡 arch: {mf.spec.arch_name}")
     print(f"💡 dim: {cfg.dim}\n💡 nLayers: {cfg.n_layers}\n💡 nHeads: {cfg.n_heads}")
     print(f"💡 nKvHeads: {cfg.n_kv_heads}\n💡 vocabSize: {cfg.vocab_size}\n💡 seqLen: {cfg.seq_len}")
-    cfg, params = load_params(mf, cfg, dtype=dtype,
-                              keep_quantized=not args.dequantize)
     mesh = parse_workers(args.workers)
     print(f"💡 mesh: tp={mesh.shape['tp']}")
+    # fused qkv/w13 is the single-chip fast layout; under tp>1 the unfused
+    # per-tensor layout shards cleanly (see load_params)
+    cfg, params = load_params(mf, cfg, dtype=dtype,
+                              keep_quantized=not args.dequantize,
+                              fuse=mesh.shape.get("tp", 1) == 1)
     kv_dtype = jnp.dtype(DTYPES[args.kv_cache_dtype]) if args.kv_cache_dtype else None
     engine = Engine(cfg, params, mesh=mesh, seq_len=args.max_seq_len, kv_dtype=kv_dtype)
     tok = Tokenizer(tfile.read_tfile(args.tokenizer))
@@ -165,42 +169,19 @@ def cmd_chat(args) -> None:
             print("🚫 context window is full")
             break
         print("\n🤖 Assistant")
-        prev = tok.bos_id
         eos_detector.clear()
-        n_prompt = len(ids)
-        prompt_end = engine.pos + n_prompt
-        budget = engine.seq_len - engine.pos
-        n_completion = 0
-        ended_by_eos = False
-        for i, (token, _) in enumerate(engine.generate_stream(
-                ids, budget, temperature=args.temperature, topp=args.topp,
-                seed=_seed(args), chunk=args.chunk,
-                eos_ids=(tok.chat_eos_id,))):
-            if i < n_prompt:
-                prev = token
-                continue
-            n_completion += 1
-            piece = tok.decode_piece(prev, token).decode("utf-8", errors="replace")
-            prev = token
-            res = eos_detector.append(token, piece)
-            if res == MAYBE_EOS:
-                continue  # hold back a potential partial stop string
-            delta = eos_detector.get_delta()
-            if delta:
-                sys.stdout.write(delta)
-                sys.stdout.flush()
-            eos_detector.clear()
-            if res == EOS:
-                ended_by_eos = True
-                break
-        if not ended_by_eos:
-            delta = eos_detector.get_delta()  # flush held-back partial match
-            if delta:
-                sys.stdout.write(delta)
-                sys.stdout.flush()
-        # drop chunk-overshoot KV so the next turn prefills at the real end
-        # of this reply (generate_stream only rewinds for eos_ids itself)
-        engine.pos = min(engine.pos, prompt_end + n_completion)
+        prompt_end = engine.pos + len(ids)
+        stream = engine.generate_stream(
+            ids, engine.seq_len - engine.pos, temperature=args.temperature,
+            topp=args.topp, seed=_seed(args), chunk=args.chunk,
+            eos_ids=(tok.chat_eos_id,))
+
+        def emit(delta):
+            sys.stdout.write(delta)
+            sys.stdout.flush()
+
+        drain_generation(engine, tok, eos_detector, stream, len(ids),
+                         prompt_end, emit)
         print()
 
 

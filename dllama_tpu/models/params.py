@@ -79,34 +79,55 @@ def _stack(mf: mfile.MFile, names: list[str], transpose: bool, dtype) -> np.ndar
     return np.stack(mats).astype(dtype)
 
 
-def _stack_q(mf: mfile.MFile, names: list[str]) -> q40.QTensor:
+def _stack_q(mf: mfile.MFile, names: list[str | list[str]]) -> q40.QTensor:
     """Layer-stack Q40 tensors straight from their packed file bytes —
     the weights never touch f32 on host (the reference likewise keeps Q40
-    end-to-end on its production path, funcs.cpp:287-386)."""
+    end-to-end on its production path, funcs.cpp:287-386).
+
+    An inner list of names concatenates those tensors' output dims into one
+    fused weight (e.g. q+k+v), which halves-again the fused kernel's launch
+    count per layer."""
     qs, ss = [], []
     for name in names:
-        qvals, scales = mf.q40_planes(name)          # (d_out, n_in) planes
-        qs.append(qvals)
-        ss.append(scales)
+        group = [name] if isinstance(name, str) else name
+        planes = [mf.q40_planes(g) for g in group]   # (d_out, n_in) each
+        qs.append(np.concatenate([p[0] for p in planes], axis=0))
+        ss.append(np.concatenate([p[1] for p in planes], axis=0))
     return q40.pack_planes_t(np.stack(qs), np.stack(ss))
 
 
-def quantize_matmuls(params: Params, cfg: ModelConfig) -> Params:
+def quantize_matmuls(params: Params, cfg: ModelConfig,
+                     fuse: bool = True) -> Params:
     """Convert the dense matmul weights of a params pytree to packed Q40
     (host-side).  Used by benchmarks/tests to exercise the quantized path
     from randomly-initialized params; MoE expert tensors and the embedding
-    stay dense (expert dispatch needs gatherable arrays)."""
+    stay dense (expert dispatch needs gatherable arrays).
+
+    ``fuse=True`` additionally concatenates q/k/v (and w1/w3) output dims
+    into single ``wqkv``/``w13`` tensors — see load_params."""
     out = dict(params)
-    keys = ["wq", "wk", "wv", "wo", "wcls"]
-    if not cfg.is_moe:
-        keys += ["w1", "w2", "w3"]
+    if fuse:
+        out["wqkv"] = q40.quantize(np.concatenate(
+            [np.asarray(params[k], np.float32) for k in ("wq", "wk", "wv")], axis=-1))
+        del out["wq"], out["wk"], out["wv"]
+        keys = ["wo", "wcls"]
+        if not cfg.is_moe:
+            out["w13"] = q40.quantize(np.concatenate(
+                [np.asarray(params[k], np.float32) for k in ("w1", "w3")], axis=-1))
+            del out["w1"], out["w3"]
+            keys.append("w2")
+    else:
+        keys = ["wq", "wk", "wv", "wo", "wcls"]
+        if not cfg.is_moe:
+            keys += ["w1", "w2", "w3"]
     for k in keys:
         out[k] = q40.quantize(np.asarray(params[k], np.float32))
     return out
 
 
 def load_params(mf: mfile.MFile, cfg: ModelConfig | None = None,
-                dtype=None, keep_quantized: bool = False) -> tuple[ModelConfig, Params]:
+                dtype=None, keep_quantized: bool = False,
+                fuse: bool = True) -> tuple[ModelConfig, Params]:
     """Load a `.m` file into the runtime layout.
 
     Mirrors ``Transformer::loadRoot`` (transformer.cpp:428-487) but instead
@@ -118,6 +139,11 @@ def load_params(mf: mfile.MFile, cfg: ModelConfig | None = None,
     dequant-matmul (ops/q40.py) — the production path, 3.5× the decode
     bandwidth of dense bf16.  Non-Q40 tensors (norms, embedding, MoE
     experts) are dequantized either way.
+
+    ``fuse=True`` concatenates q/k/v (and w1/w3) into single ``wqkv``/
+    ``w13`` tensors on the quantized path — right for single-chip decode
+    (fewer kernel launches); pass ``fuse=False`` under tp>1, where the
+    concat axis would be shard-mixed and GSPMD would reshard every step.
     """
     if cfg is None:
         cfg = ModelConfig.from_spec(mf.spec)
@@ -128,11 +154,17 @@ def load_params(mf: mfile.MFile, cfg: ModelConfig | None = None,
     L = cfg.n_layers
     p: Params = {}
     p["embedding"] = mf.tensor("token_embedding").astype(np_dtype)
-    for key, fname, transpose in [
-        ("wq", "wq", True), ("wk", "wk", True), ("wv", "wv", True), ("wo", "wo", True),
-    ]:
-        names = [f"layers.{i}.{fname}" for i in range(L)]
-        p[key] = _stack_q(mf, names) if quant else _stack(mf, names, transpose, np_dtype)
+    if quant and fuse:
+        p["wqkv"] = _stack_q(
+            mf, [[f"layers.{i}.wq", f"layers.{i}.wk", f"layers.{i}.wv"]
+                 for i in range(L)])
+        p["wo"] = _stack_q(mf, [f"layers.{i}.wo" for i in range(L)])
+    elif quant:
+        for key in ("wq", "wk", "wv", "wo"):
+            p[key] = _stack_q(mf, [f"layers.{i}.{key}" for i in range(L)])
+    else:
+        for key in ("wq", "wk", "wv", "wo"):
+            p[key] = _stack(mf, [f"layers.{i}.{key}" for i in range(L)], True, np_dtype)
     p["rms_att"] = _stack(mf, [f"layers.{i}.rms_att" for i in range(L)], False, np.float32)
     p["rms_ffn"] = _stack(mf, [f"layers.{i}.rms_ffn" for i in range(L)], False, np.float32)
     if cfg.is_moe:
@@ -147,10 +179,16 @@ def load_params(mf: mfile.MFile, cfg: ModelConfig | None = None,
         if cfg.post_block_norms:
             p["rms_moe"] = _stack(mf, [f"layers.{i}.rms_moe" for i in range(L)], False, np.float32)
             p["rms_ffn2"] = _stack(mf, [f"layers.{i}.rms_ffn2" for i in range(L)], False, np.float32)
+    elif quant and fuse:
+        p["w13"] = _stack_q(
+            mf, [[f"layers.{i}.w1", f"layers.{i}.w3"] for i in range(L)])
+        p["w2"] = _stack_q(mf, [f"layers.{i}.w2" for i in range(L)])
+    elif quant:
+        for key in ("w1", "w2", "w3"):
+            p[key] = _stack_q(mf, [f"layers.{i}.{key}" for i in range(L)])
     else:
         for key in ("w1", "w2", "w3"):
-            names = [f"layers.{i}.{key}" for i in range(L)]
-            p[key] = _stack_q(mf, names) if quant else _stack(mf, names, True, np_dtype)
+            p[key] = _stack(mf, [f"layers.{i}.{key}" for i in range(L)], True, np_dtype)
     p["rms_final"] = mf.tensor("rms_final").astype(np.float32)
     if quant:
         p["wcls"] = q40.pack_planes_t(*mf.q40_planes("wcls"))

@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from ..ops import q40
 from ..ops.attention import gqa_attention, update_kv_cache
 from ..ops.kernels import ACTIVATIONS, apply_rope, rmsnorm, rope_angles, softmax_f32
+from ..ops.sp_attention import sp_gqa_attention
+from ..parallel.mesh import get_active_mesh
 from .config import ModelConfig
 from .params import Params
 
@@ -67,9 +69,16 @@ def _attention_block(x, lp, cfg: ModelConfig, k_cache, v_cache, cos, sin, pos):
     hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_size
 
     xb = rmsnorm(x, lp["rms_att"])
-    q = _mm(xb, lp["wq"], cfg).reshape(b, t, hq, dh)
-    k = _mm(xb, lp["wk"], cfg).reshape(b, t, hkv, dh)
-    v = _mm(xb, lp["wv"], cfg).reshape(b, t, hkv, dh)
+    if "wqkv" in lp:  # fused projection (quantized load): one kernel launch
+        qkv = _mm(xb, lp["wqkv"], cfg)
+        q, k, v = jnp.split(qkv, [hq * dh, (hq + hkv) * dh], axis=-1)
+    else:
+        q = _mm(xb, lp["wq"], cfg)
+        k = _mm(xb, lp["wk"], cfg)
+        v = _mm(xb, lp["wv"], cfg)
+    q = q.reshape(b, t, hq, dh)
+    k = k.reshape(b, t, hkv, dh)
+    v = v.reshape(b, t, hkv, dh)
 
     q = apply_rope(q, cos, sin, interleaved=cfg.rope_interleaved)
     k = apply_rope(k, cos, sin, interleaved=cfg.rope_interleaved)
@@ -79,7 +88,12 @@ def _attention_block(x, lp, cfg: ModelConfig, k_cache, v_cache, cos, sin, pos):
     v = v.transpose(0, 2, 1, 3)
     k_cache, v_cache = update_kv_cache(k_cache, v_cache, k, v, pos)
 
-    att = gqa_attention(q, k_cache, v_cache, pos, t)
+    mesh = get_active_mesh()
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        # sequence-parallel: seq-sharded cache, distributed softmax combine
+        att = sp_gqa_attention(q, k_cache, v_cache, pos, t, mesh)
+    else:
+        att = gqa_attention(q, k_cache, v_cache, pos, t)
     att = att.transpose(0, 2, 1, 3).reshape(b, t, hq * dh)
     out = _mm(att, lp["wo"], cfg)  # col-sharded: XLA all-reduces the partial sums here
     return out, k_cache, v_cache
@@ -87,7 +101,12 @@ def _attention_block(x, lp, cfg: ModelConfig, k_cache, v_cache, cos, sin, pos):
 
 def _dense_ffn(xb, lp, cfg: ModelConfig):
     act = ACTIVATIONS[cfg.hidden_act]
-    h = act(_mm(xb, lp["w1"], cfg)) * _mm(xb, lp["w3"], cfg)
+    if "w13" in lp:  # fused gate+up (quantized load)
+        h13 = _mm(xb, lp["w13"], cfg)
+        h1, h3 = jnp.split(h13, 2, axis=-1)
+        h = act(h1) * h3
+    else:
+        h = act(_mm(xb, lp["w1"], cfg)) * _mm(xb, lp["w3"], cfg)
     return _mm(h, lp["w2"], cfg)
 
 
@@ -146,10 +165,18 @@ def run_blocks(params: Params, cfg: ModelConfig, tokens: jax.Array,
     cos, sin = rope_angles(positions, cfg.head_size, cfg.rope_theta)  # (T, Dh/2)
 
     layer_keys = [k for k in params if k not in ("embedding", "rms_final", "wcls")]
-    stacked = {k: params[k] for k in layer_keys}
+    # Packed-Q40 weights stay out of the scan's xs: the scan would slice a
+    # per-layer copy of the stacked HBM buffer every step; instead the body
+    # gets a QLayerView and the fused kernel indexes the stacked buffer
+    # directly (scalar-prefetch index_map, ops/q40.py).
+    qt_keys = [k for k in layer_keys if isinstance(params[k], q40.QTensor)]
+    stacked = {k: params[k] for k in layer_keys if k not in qt_keys}
 
     def block(x, layer):
-        lp, k_cache, v_cache = layer
+        idx, lp, k_cache, v_cache = layer
+        lp = dict(lp)
+        for k in qt_keys:
+            lp[k] = q40.QLayerView(params[k], idx)
         att_out, k_cache, v_cache = _attention_block(x, lp, cfg, k_cache, v_cache, cos, sin, pos)
         if cfg.post_block_norms:
             att_out = rmsnorm(att_out, lp["rms_ffn"])  # grokRmfFfnNorm
@@ -167,7 +194,8 @@ def run_blocks(params: Params, cfg: ModelConfig, tokens: jax.Array,
         x = x + ff
         return x, (k_cache, v_cache)
 
-    x, (k_new, v_new) = jax.lax.scan(block, x, (stacked, cache.k, cache.v))
+    x, (k_new, v_new) = jax.lax.scan(
+        block, x, (jnp.arange(cfg.n_layers), stacked, cache.k, cache.v))
     return x, KVCache(k_new, v_new)
 
 

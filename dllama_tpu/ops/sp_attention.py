@@ -1,0 +1,94 @@
+"""Sequence-parallel attention: seq-sharded KV cache + distributed softmax.
+
+Long-context capability the reference does not have (SURVEY §5: its only
+long-context lever is TP's 1/n KV shrink; seqLen is a hard per-node
+ceiling, commands.hpp:12).  Here the KV cache's sequence axis is sharded
+over the mesh's ``sp`` axis, so max context scales with sp × per-chip HBM.
+
+Algorithm (flash-attention softmax decomposition across shards):
+each sp shard holds KV positions ``[i·C, (i+1)·C)`` and computes, for the
+(replicated) queries, its local masked scores, local running max ``m_i``,
+partial denominator ``l_i = Σ exp(s−m_i)`` and partial numerator
+``o_i = exp(s−m_i)·V_i``.  The global softmax is reassembled with one
+``all_gather`` of the (tiny) ``m_i`` plus two ``psum``s:
+
+    M = max_i m_i;   out = Σ_i e^{m_i−M}·o_i  /  Σ_i e^{m_i−M}·l_i
+
+— a single ICI round regardless of sequence length, versus the
+O(n_shards) steps of a rotation-based ring.  (A ppermute ring variant
+makes sense for sharded-Q prefill; for decode and replicated-Q prefill
+the one-round combine is strictly better.)
+
+The KV cache *update* stays outside this module: ``update_kv_cache`` is a
+plain dynamic_update_slice that GSPMD lowers to a masked write on the
+owning shard.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+NEG_BIG = -1e30  # stand-in for -inf that keeps exp() NaN-free on empty shards
+
+
+def _local_partials(q, k, v, pos, q_len, chunk_start):
+    """Per-shard partial attention.
+
+    q: (B, Hkv, G, T, Dh) f32 — grouped queries
+    k/v: (B, Hkv, C, Dh) — this shard's chunk
+    Returns (o_i (B,Hkv,G,T,Dh), l_i (B,Hkv,G,T), m_i (B,Hkv,G,T)).
+    """
+    c = k.shape[2]
+    scores = jnp.einsum("bhgtd,bhsd->bhgts", q, k.astype(jnp.float32))
+    scores = scores / jnp.sqrt(jnp.float32(q.shape[-1]))
+
+    s_idx = chunk_start + jnp.arange(c)[None, :]          # global key positions
+    t_idx = pos + jnp.arange(q_len)[:, None]
+    mask = s_idx <= t_idx                                  # (T, C) causal+validity
+    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+
+    m_i = jnp.maximum(jnp.max(scores, axis=-1), NEG_BIG)   # (B,Hkv,G,T)
+    p = jnp.exp(scores - m_i[..., None])                   # masked → exp(-inf)=0
+    l_i = jnp.sum(p, axis=-1)
+    o_i = jnp.einsum("bhgts,bhsd->bhgtd", p, v.astype(jnp.float32))
+    return o_i, l_i, m_i
+
+
+def sp_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                     pos: jax.Array, q_len: int, mesh,
+                     q_spec: P = P("dp", "tp", None, None),
+                     kv_spec: P = P("dp", "tp", "sp", None)) -> jax.Array:
+    """Causal GQA over a seq-sharded cache (drop-in for
+    ops.attention.gqa_attention when the mesh has an ``sp`` axis).
+
+    q: (B, Hq, T, Dh); k_cache/v_cache: (B, Hkv, S, Dh) with S sharded on
+    ``sp``; returns (B, Hq, T, Dh) sharded like q.
+    """
+    b, hq, t, dh = q.shape
+    hkv = k_cache.shape[1]
+    g = hq // hkv
+    sp = mesh.shape.get("sp", 1)
+    chunk = k_cache.shape[2] // sp
+
+    def shard_fn(q, k, v):
+        # local shapes: q (b/dp, hq/tp, T, Dh), k/v (b/dp, hkv/tp, C, Dh)
+        hq_l = q.shape[1]
+        hkv_l = k.shape[1]
+        qf = q.astype(jnp.float32).reshape(q.shape[0], hkv_l, hq_l // hkv_l, t, dh)
+        chunk_start = jax.lax.axis_index("sp") * chunk
+        o_i, l_i, m_i = _local_partials(qf, k, v, pos, q_len, chunk_start)
+
+        m = jnp.max(jax.lax.all_gather(m_i, "sp"), axis=0)   # global max
+        scale = jnp.exp(m_i - m)
+        out = jax.lax.psum(o_i * scale[..., None], "sp")
+        denom = jax.lax.psum(l_i * scale, "sp")
+        out = out / jnp.maximum(denom[..., None], 1e-38)
+        return out.reshape(q.shape[0], hq_l, t, dh).astype(q.dtype)
+
+    return jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
+    )(q, k_cache, v_cache)

@@ -16,9 +16,31 @@ Axes:
 
 from __future__ import annotations
 
+import contextlib
+
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+# --- active mesh -----------------------------------------------------------
+# The transformer's attention dispatch reads this at *trace* time to decide
+# whether to run the sequence-parallel shard_map path (ops/sp_attention.py).
+# The Engine enters the context around its jitted calls; tracing happens on
+# the first call, so the mesh is visible exactly when the decision is made.
+_ACTIVE: list[Mesh] = []
+
+
+@contextlib.contextmanager
+def active_mesh(mesh: Mesh):
+    _ACTIVE.append(mesh)
+    try:
+        yield mesh
+    finally:
+        _ACTIVE.pop()
+
+
+def get_active_mesh() -> Mesh | None:
+    return _ACTIVE[-1] if _ACTIVE else None
 
 
 def make_mesh(tp: int | None = None, sp: int = 1, dp: int = 1,

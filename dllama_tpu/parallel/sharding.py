@@ -57,7 +57,12 @@ def param_specs(cfg: ModelConfig) -> dict[str, P]:
         "wq": P(None, None, "tp"),           # RowMatmulSlice: out dim = heads
         "wk": P(None, None, "tp"),
         "wv": P(None, None, "tp"),
+        "wqkv": P(None, None, "tp"),         # fused q|k|v (quantized load): the concat
+                                             # axis is shard-mixed, so GSPMD reshards at
+                                             # the split — correct, but unfused layouts
+                                             # are preferred for tp>1
         "wo": P(None, "tp", None),           # ColMatmulSlice: in dim = heads
+        "w13": P(None, None, "tp"),
         "rms_att": REPL,
         "rms_ffn": REPL,
         "rms_final": REPL,

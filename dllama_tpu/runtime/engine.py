@@ -32,7 +32,7 @@ from ..models.config import ModelConfig
 from ..models.params import Params
 from ..models.transformer import KVCache, forward_last, init_kv_cache
 from ..parallel import sharding
-from ..parallel.mesh import make_mesh
+from ..parallel.mesh import active_mesh, make_mesh
 from ..sampling import Sampler
 
 
@@ -94,11 +94,19 @@ class Engine:
         # is respected.
         if tp > 1 and cfg.quant_impl in ("auto", "pallas"):
             cfg = cfg.with_(quant_impl="xla")
+        self.sp = self.mesh.shape.get("sp", 1)
+        if self.sp > 1:
+            if self.seq_len % self.sp:
+                raise ValueError(f"seq_len {self.seq_len} not divisible by sp={self.sp}")
+            if cfg.quant_impl in ("auto", "pallas"):
+                cfg = cfg.with_(quant_impl="xla")  # multi-device program
         self.cfg = cfg
         self.params = sharding.place_params(params, cfg, self.mesh)
+        # sp>1 shards the cache's sequence axis: max context scales with
+        # sp × per-chip HBM (capability the reference lacks, SURVEY §5)
         self.cache = jax.device_put(
             init_kv_cache(cfg, batch, self.seq_len, dtype=kv_dtype),
-            sharding.kv_cache_sharding(self.mesh))
+            sharding.kv_cache_sharding(self.mesh, "sp" if self.sp > 1 else None))
         self.pos = 0
 
         def step(params, cache, tokens, pos, last_index):
@@ -118,9 +126,10 @@ class Engine:
     def _run(self, tokens_np: np.ndarray, last_index: int) -> tuple[np.ndarray, StepStats]:
         stats = StepStats()
         t0 = time.perf_counter()
-        logits, self.cache = self._step(
-            self.params, self.cache, jnp.asarray(tokens_np),
-            jnp.int32(self.pos), jnp.int32(last_index))
+        with active_mesh(self.mesh):  # read at trace time (first call)
+            logits, self.cache = self._step(
+                self.params, self.cache, jnp.asarray(tokens_np),
+                jnp.int32(self.pos), jnp.int32(last_index))
         logits.block_until_ready()
         t1 = time.perf_counter()
         host_logits = np.asarray(logits)  # (B, V)
@@ -208,9 +217,10 @@ class Engine:
             self._chunk_counter += 1
             p0 = self.pos
             t0 = time.perf_counter()
-            toks_dev, self.cache, _last, _pos, _key = fn(
-                self.params, self.cache,
-                jnp.full((self.batch,), token, jnp.int32), jnp.int32(p0), sub)
+            with active_mesh(self.mesh):
+                toks_dev, self.cache, _last, _pos, _key = fn(
+                    self.params, self.cache,
+                    jnp.full((self.batch,), token, jnp.int32), jnp.int32(p0), sub)
             jax.block_until_ready(toks_dev)
             t1 = time.perf_counter()
             toks = np.asarray(toks_dev)[:, 0]  # (k,)

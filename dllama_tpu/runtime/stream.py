@@ -1,0 +1,58 @@
+"""Shared generation-stream consumption for the chat CLI and the API server.
+
+One state machine (prompt-echo skip, EOS/stop-string detection with
+held-back partial matches, end-of-budget flush, KV overshoot rewind) so the
+two front ends cannot drift: the pos-rewind arithmetic interacts with
+``Engine.generate_stream``'s own eos-id rewind and the on-device chunk
+overshoot, and must stay identical in both.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..tokenizer.eos import EOS, MAYBE_EOS, EosDetector
+
+
+def drain_generation(engine, tokenizer, detector: EosDetector, stream,
+                     n_prompt: int, prompt_end: int,
+                     on_delta: Callable[[str], None]) -> tuple[str, int, bool]:
+    """Consume ``stream`` (an Engine.generate_stream iterator), calling
+    ``on_delta(text)`` as text becomes safe to emit.
+
+    Returns ``(reply, n_completion, ended_by_eos)``.  On return,
+    ``engine.pos`` has been rewound past any chunk-overshoot tokens that
+    were sampled after a stop string — they were never part of the reply
+    and must not condition later turns.
+    """
+    content: list[str] = []
+    prev = tokenizer.bos_id
+    n_completion = 0
+    ended_by_eos = False
+    for i, (token, _) in enumerate(stream):
+        if i < n_prompt:  # prompt tokens are echoed first (engine contract)
+            prev = token
+            continue
+        n_completion += 1
+        piece = tokenizer.decode_piece(prev, token).decode("utf-8", errors="replace")
+        prev = token
+        res = detector.append(token, piece)
+        if res == MAYBE_EOS:
+            continue  # hold back a potential partial stop-string match
+        delta = detector.get_delta()
+        if delta:
+            content.append(delta)
+            on_delta(delta)
+        detector.clear()
+        if res == EOS:
+            ended_by_eos = True
+            break
+    if not ended_by_eos:
+        # budget exhausted with a partial stop-string match held back —
+        # it was real text, flush it
+        delta = detector.get_delta()
+        if delta:
+            content.append(delta)
+            on_delta(delta)
+    engine.pos = min(engine.pos, prompt_end + n_completion)
+    return "".join(content), n_completion, ended_by_eos

@@ -25,9 +25,10 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, HTTPServer
 
 from ..runtime.engine import Engine
+from ..runtime.stream import drain_generation
 from ..tokenizer.bpe import Tokenizer
 from ..tokenizer.chat import ChatItem, ChatTemplate, TokenizerChatStops
-from ..tokenizer.eos import EOS, MAYBE_EOS, EosDetector
+from ..tokenizer.eos import EosDetector
 
 
 @dataclass
@@ -156,44 +157,12 @@ class ApiState:
                                padding_left=2, padding_right=2)
         seed = params.seed if params.seed is not None else int(time.time())
 
-        content = []
-        prev = tok.bos_id
-        n_completion = 0
         stream = engine.generate_stream(
             prompt_tokens, budget, temperature=params.temperature,
             topp=params.top_p, seed=seed, chunk=self.chunk,
             eos_ids=(tok.chat_eos_id,))
-        ended_by_eos = False
-        for i, (token, _) in enumerate(stream):
-            if i < len(prompt_tokens):
-                prev = token
-                continue
-            n_completion += 1
-            piece = tok.decode_piece(prev, token).decode("utf-8", errors="replace")
-            prev = token
-            res = detector.append(token, piece)
-            if res == MAYBE_EOS:
-                continue
-            delta = detector.get_delta()
-            if delta:
-                content.append(delta)
-                emit(delta)
-            detector.clear()
-            if res == EOS:
-                ended_by_eos = True
-                break
-        if not ended_by_eos:
-            # budget exhausted with a partial stop-string match held back —
-            # it was real text, flush it
-            delta = detector.get_delta()
-            if delta:
-                content.append(delta)
-                emit(delta)
-        # discard chunk-overshoot KV: tokens sampled past a stop string were
-        # never part of the reply, and must not condition later turns
-        engine.pos = min(engine.pos, prompt_end + n_completion)
-
-        reply = "".join(content)
+        reply, n_completion, _ = drain_generation(
+            engine, tok, detector, stream, len(prompt_tokens), prompt_end, emit)
         if engine.pos >= engine.seq_len:
             self.naive_cache.clear()  # context exhausted (dllama-api.cpp:330-331)
         else:
@@ -260,9 +229,16 @@ def make_handler(state: ApiState):
 
                 try:
                     state.complete(params, emit)
-                except ValueError as e:  # headers already sent: error event
-                    self.wfile.write(
-                        f"data: {json.dumps({'error': str(e)})}\n\n".encode())
+                except ValueError as e:
+                    # headers already sent: emit an OpenAI-shaped error
+                    # object and terminate WITHOUT a normal finish chunk, so
+                    # clients don't mistake the failure for an empty success
+                    err = {"error": {"message": str(e),
+                                     "type": "invalid_request_error"}}
+                    self.wfile.write(f"data: {json.dumps(err)}\n\n".encode())
+                    self.wfile.write(b"data: [DONE]\n\n")
+                    self.wfile.flush()
+                    return
                 final = {"id": cid, "object": "chat.completion.chunk",
                          "created": created, "model": state.model_name,
                          "choices": [{"index": 0, "delta": {}, "finish_reason": "stop"}]}

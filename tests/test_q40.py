@@ -164,9 +164,10 @@ class TestEngineIntegration:
         for keep in (True, False):
             cfg_l, params = load_params(mf, cfg, keep_quantized=keep)
             if keep:
-                assert isinstance(params["wq"], q40.QTensor)
-                # a Q40 load must not materialize dense f32 matmul weights
-                assert isinstance(params["w1"], q40.QTensor)
+                # a Q40 load keeps packed fused projections, no dense f32
+                assert isinstance(params["wqkv"], q40.QTensor)
+                assert isinstance(params["w13"], q40.QTensor)
+                assert params["wqkv"].logical_nd == (64, 64 + 2 * 32)
             eng = Engine(cfg_l, params)
             toks = [t for t, _ in eng.generate(
                 [1, 5, 9], steps=10, sampler=Sampler(cfg.vocab_size, 0.0, 0.9, 0))]
