@@ -57,11 +57,13 @@ def init_kv_cache(cfg: ModelConfig, batch: int, seq_len: int | None = None,
     return KVCache(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
 
 
-def _mm(x, w, cfg: ModelConfig):
+def _mm(x, w, cfg: ModelConfig, kind: str | None = None):
     """Matmul that accepts dense arrays or packed Q40 weights.  Weight
     dtype/format is a per-tensor property (the reference likewise
-    dispatches per weight dtype, funcs.cpp:414-455)."""
-    return q40.mm(x, w, impl=cfg.quant_impl).astype(cfg.dtype)
+    dispatches per weight dtype, funcs.cpp:414-455).  ``kind`` declares the
+    weight's TP slicing ("row"/"col", commands.cpp:8-70) so the fused
+    kernel can run per shard on a multi-device mesh (ops/q40.py)."""
+    return q40.mm(x, w, impl=cfg.quant_impl, kind=kind).astype(cfg.dtype)
 
 
 def _attention_block(x, lp, cfg: ModelConfig, k_cache, v_cache, cos, sin, pos):
@@ -73,9 +75,9 @@ def _attention_block(x, lp, cfg: ModelConfig, k_cache, v_cache, cos, sin, pos):
         qkv = _mm(xb, lp["wqkv"], cfg)
         q, k, v = jnp.split(qkv, [hq * dh, (hq + hkv) * dh], axis=-1)
     else:
-        q = _mm(xb, lp["wq"], cfg)
-        k = _mm(xb, lp["wk"], cfg)
-        v = _mm(xb, lp["wv"], cfg)
+        q = _mm(xb, lp["wq"], cfg, kind="row")
+        k = _mm(xb, lp["wk"], cfg, kind="row")
+        v = _mm(xb, lp["wv"], cfg, kind="row")
     q = q.reshape(b, t, hq, dh)
     k = k.reshape(b, t, hkv, dh)
     v = v.reshape(b, t, hkv, dh)
@@ -95,7 +97,7 @@ def _attention_block(x, lp, cfg: ModelConfig, k_cache, v_cache, cos, sin, pos):
     else:
         att = gqa_attention(q, k_cache, v_cache, pos, t)
     att = att.transpose(0, 2, 1, 3).reshape(b, t, hq * dh)
-    out = _mm(att, lp["wo"], cfg)  # col-sharded: XLA all-reduces the partial sums here
+    out = _mm(att, lp["wo"], cfg, kind="col")  # col-sharded: partial sums all-reduced here
     return out, k_cache, v_cache
 
 
@@ -106,8 +108,8 @@ def _dense_ffn(xb, lp, cfg: ModelConfig):
         h1, h3 = jnp.split(h13, 2, axis=-1)
         h = act(h1) * h3
     else:
-        h = act(_mm(xb, lp["w1"], cfg)) * _mm(xb, lp["w3"], cfg)
-    return _mm(h, lp["w2"], cfg)
+        h = act(_mm(xb, lp["w1"], cfg, kind="row")) * _mm(xb, lp["w3"], cfg, kind="row")
+    return _mm(h, lp["w2"], cfg, kind="col")
 
 
 def moe_ffn(xb2d: jax.Array, lp, cfg: ModelConfig) -> jax.Array:
@@ -203,7 +205,8 @@ def _head(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
     x = rmsnorm(x, params["rms_final"])
     # out_dtype=f32 keeps the matmul's f32 accumulation for the sampler
     # instead of a round trip through the bf16 activation dtype
-    logits = q40.mm(x, params["wcls"], impl=cfg.quant_impl, out_dtype=jnp.float32)
+    logits = q40.mm(x, params["wcls"], impl=cfg.quant_impl, out_dtype=jnp.float32,
+                    kind="row")
     if cfg.logit_scale != 1.0:
         logits = logits * cfg.logit_scale
     return logits

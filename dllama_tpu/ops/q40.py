@@ -6,8 +6,8 @@ reads 4-bit weight nibbles, applies per-32-block f16 scales, and
 accumulates against quantized activations.  Here the weights stay packed in
 HBM and a Pallas kernel fuses nibble-unpack + scale + matmul, so decode —
 which is HBM-bandwidth-bound — streams 0.5625 bytes/weight instead of 2
-(bf16): measured ~810 GB/s effective weight stream on v5e, ~3.5× faster
-than the bf16 matvec.
+(bf16), a ~3.5× roofline advantage over the bf16 matvec.  (Design target;
+driver-captured numbers live in BENCH_r*.json.)
 
 Device layout (block-local, chosen so any 32-row slice is self-contained
 and therefore tensor-parallel sharding on either axis never splits a
@@ -24,13 +24,21 @@ block):
 
 Two matmul implementations:
 
-* ``pallas`` — the fused kernel, for single-chip decode (a `pallas_call`
-  is not auto-partitioned by GSPMD, so it requires unsharded weights).
+* ``pallas`` — the fused kernel.  A `pallas_call` is not auto-partitioned
+  by GSPMD, so on a multi-device mesh it runs **per shard under
+  ``jax.shard_map``** (see :func:`_sharded_matmul`): the caller declares the
+  weight's TP slicing ``kind`` — ``"row"`` (output dim sharded, the
+  reference's RowMatmulSlice, commands.cpp:8-40: no communication) or
+  ``"col"`` (input dim sharded, ColMatmulSlice commands.cpp:42-70: one
+  ``psum`` over ``tp`` for the partial sums, the all-reduce the reference
+  hand-rolls as gather+merge+rebroadcast, llama2-tasks.cpp:115-131).  The
+  block-local packed layout guarantees an even shard never splits a
+  quantization block on either axis.
 * ``xla``   — plain-jnp emulation (unpack → scale → dot).  Partitionable
   under GSPMD (reshapes split the sharded axis at block granularity), used
-  for tensor-parallel execution, prefill (compute-bound anyway), and CPU
-  tests.  XLA materializes the dequantized operand, so it is not the fast
-  path for decode.
+  for prefill (compute-bound anyway), CPU tests, and as the fallback when
+  shapes don't divide the mesh evenly.  XLA materializes the dequantized
+  operand, so it is not the fast path for decode.
 
 Activations stay bf16 — the TPU analogue of the reference's Q80 activation
 quantization (whose purpose is wire compression, tasks.cpp:124-163; on a
@@ -48,8 +56,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
 
 from .. import quants
+from ..parallel.mesh import get_active_mesh
 
 # Sweet spot measured on v5e (HBM-roofline for the 4096×11008 matvec);
 # shrunk automatically when N or D is smaller.
@@ -147,6 +157,24 @@ def from_q40_bytes(raw: np.ndarray, d_out: int, n_in: int) -> QTensor:
     return pack_planes_t(*quants.q40_planes(raw, (d_out, n_in)))
 
 
+def split_d(qt: QTensor, sizes: list[int]) -> list[QTensor]:
+    """Split a (possibly layer-stacked) QTensor along its output dim.
+
+    Used to unfuse ``wqkv``/``w13`` for tensor-parallel placement: the
+    output axis is the packed arrays' last axis, so the split is a pure
+    slice (no repacking); each piece stays block-aligned on the input axis.
+    """
+    n = qt.logical_nd[0]
+    out, off = [], 0
+    for s in sizes:
+        out.append(QTensor(qt.qpacked[..., :, off:off + s],
+                           qt.scales[..., :, off:off + s], (n, s)))
+        off += s
+    if off != qt.logical_nd[1]:
+        raise ValueError(f"split sizes {sizes} != output dim {qt.logical_nd[1]}")
+    return out
+
+
 def dequantize(qt: QTensor, dtype=jnp.float32) -> jax.Array:
     """Reconstruct the dense array (tests / the XLA matmul path)."""
     *lead, n2, d = qt.qpacked.shape
@@ -201,9 +229,16 @@ def _stacked_q40_kernel(lidx_ref, x_ref, qp_ref, s_ref, o_ref, acc_ref, *, nstep
 
 
 def _tiles(n: int, d: int) -> tuple[int, int]:
-    """Pack-time padding guarantees n is a TILE_N multiple (or a single
-    full-axis tile); the ragged last D tile is masked on store."""
-    tile_n = TILE_N if n % TILE_N == 0 else n
+    """Pick reduction/output tile sizes; the ragged last D tile is masked
+    on store.  Pack-time padding makes n a TILE_N multiple for whole
+    tensors; a TP shard's local n may be a smaller power-of-two multiple
+    (padded_n/tp), so fall down the divisor ladder rather than taking the
+    whole axis as one tile (which would blow VMEM at 7B shapes)."""
+    tile_n = n
+    for tn in (TILE_N, TILE_N // 2, TILE_N // 4, TILE_N // 8, TILE_N // 16, 32):
+        if n % tn == 0:
+            tile_n = tn
+            break
     tile_d = min(TILE_D, d) if d % 128 == 0 else TILE_D
     return tile_n, tile_d
 
@@ -296,12 +331,114 @@ def _pad_x(x2: jax.Array, n: int, np_: int) -> jax.Array:
     return jnp.pad(x2, ((0, 0), (0, np_ - n)))  # zeros meet zero pad scales
 
 
+# ---------------------------------------------------------------------------
+# Tensor-parallel dispatch: per-shard pallas under shard_map
+# ---------------------------------------------------------------------------
+
+def _smap_mesh():
+    """The active mesh, if the fused kernel must be run per shard."""
+    mesh = get_active_mesh()
+    if mesh is None or mesh.size <= 1:
+        return None
+    return mesh
+
+
+def _tp_shardable(np_: int, d: int, kind: str | None, tp: int) -> bool:
+    """An even shard must not split a 32-row quantization block (col) or
+    leave a ragged output chunk (row).  With tp==1 (an sp/dp-only mesh)
+    the kernel runs replicated under shard_map — always legal, any kind."""
+    if tp == 1:
+        return True
+    if kind == "row":
+        return d % tp == 0
+    if kind == "col":
+        return np_ % (32 * tp) == 0
+    return False
+
+
+def _sharded_matmul(x2: jax.Array, qt: QTensor, layer: jax.Array | None,
+                    kind: str, mesh, interp: bool) -> jax.Array:
+    """Run the fused kernel per shard under ``shard_map``.
+
+    ``kind="row"``: weight output dim sharded on ``tp`` — each shard
+    computes its slice of the output from the (replicated) input; no
+    communication, matching RowMatmulSlice (commands.cpp:8-40).
+
+    ``kind="col"``: weight input dim sharded — each shard contracts its
+    input slice into a full-width partial sum, combined with one ``psum``
+    over ``tp`` (ColMatmulSlice + the root merge, commands.cpp:42-70,
+    llama2-tasks.cpp:125-131).  The pack-time padding sits at the global
+    end of the input axis, so activation columns and packed rows shard at
+    the same logical boundaries.
+
+    Axes other than ``tp`` (``dp``/``sp``) are unmentioned in the specs:
+    shard_map treats the operands as replicated across them, which is
+    exactly the activations' layout in this framework.
+    """
+    stacked = layer is not None
+    if mesh.shape.get("tp", 1) == 1 or kind == "row":
+        # tp==1 (sp/dp-only mesh): fully replicated specs — each device runs
+        # the whole kernel; shard_map only exists to keep GSPMD from trying
+        # (and failing) to partition the pallas_call
+        tp_ax = "tp" if kind in ("row", "col") and mesh.shape.get("tp", 1) > 1 else None
+        wspec = P(None, None, tp_ax) if stacked else P(None, tp_ax)
+        xspec, ospec = P(None, None), P(None, tp_ax)
+        kind = "row" if tp_ax else "repl"
+    else:
+        wspec = P(None, "tp", None) if stacked else P("tp", None)
+        xspec, ospec = P(None, "tp"), P(None, None)
+
+    def body(x_local, qp, s, *l):
+        if stacked:
+            out = _pallas_matmul_stacked(x_local, qp, s, l[0], interpret=interp)
+        else:
+            out = _pallas_matmul(x_local, qp, s, interpret=interp)
+        if kind == "col":
+            out = jax.lax.psum(out, "tp")
+        return out
+
+    args = [x2, qt.qpacked, qt.scales] + ([layer] if stacked else [])
+    in_specs = [xspec, wspec, wspec] + ([P()] if stacked else [])
+    return jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                         out_specs=ospec, check_vma=False)(*args)
+
+
+_FALLBACK_WARNED: set = set()
+
+
+@functools.cache
+def _pallas_ok() -> bool:
+    """One-time hardware probe: can Mosaic lower + run the fused kernel?
+
+    Guards the ``auto`` dispatch so a lowering regression degrades to the
+    XLA emulation with a warning instead of crashing single-chip decode
+    (the kernel's correctness is asserted in bench startup; this only
+    gates availability)."""
+    try:
+        qt = quantize(np.ones((64, 128), np.float32))
+        out = _pallas_matmul(jnp.ones((1, 64), jnp.bfloat16), qt.qpacked, qt.scales)
+        ref = jnp.ones((1, 64), jnp.bfloat16) @ dequantize(qt, jnp.bfloat16)
+        if not np.allclose(np.asarray(out), np.asarray(ref), atol=1e-2):
+            raise AssertionError("pallas probe result mismatch")
+        return True
+    except Exception as e:  # Mosaic lowering/runtime failure
+        print(f"⚠️  q40: fused pallas kernel unavailable on this backend "
+              f"({type(e).__name__}: {str(e)[:120]}); using the XLA dequant path")
+        return False
+
+
 def matmul(x: jax.Array, qt: QTensor | QLayerView, impl: str = "auto",
-           out_dtype=None) -> jax.Array:
+           out_dtype=None, kind: str | None = None) -> jax.Array:
     """``x @ dequantize(qt)`` with f32 accumulation.
 
     x: (..., n); qt logical (n, d) — a 2-D QTensor or a QLayerView of a
     stacked one.  Returns (..., d).
+
+    ``kind`` declares the weight's TP slicing on a multi-device mesh
+    ("row" = output dim on ``tp``, "col" = input dim on ``tp``) so the
+    pallas path can run per shard; without it (or when shapes don't divide
+    the mesh evenly) a multi-device pallas request falls back to the
+    GSPMD-partitionable XLA emulation.
     """
     n, d = qt.logical_nd
     lead = x.shape[:-1]
@@ -310,20 +447,36 @@ def matmul(x: jax.Array, qt: QTensor | QLayerView, impl: str = "auto",
 
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
-        impl = "pallas" if (on_tpu and rows <= PALLAS_MAX_ROWS) else "xla"
+        impl = "pallas" if (on_tpu and rows <= PALLAS_MAX_ROWS and _pallas_ok()) else "xla"
 
     if impl in ("pallas", "pallas_interpret"):
         interp = impl == "pallas_interpret"
-        np_ = (qt.qt if isinstance(qt, QLayerView) else qt).qpacked.shape[-2] * 2
-        x2 = _pad_x(x.reshape(rows, n), n, np_)
-        if isinstance(qt, QLayerView):
-            out = _pallas_matmul_stacked(x2, qt.qt.qpacked, qt.qt.scales,
-                                         qt.layer, interpret=interp)
+        qt_full = qt.qt if isinstance(qt, QLayerView) else qt
+        np_ = qt_full.qpacked.shape[-2] * 2
+        mesh = _smap_mesh()
+        if mesh is not None:
+            tp = mesh.shape.get("tp", 1)
+            if _tp_shardable(np_, d, kind, tp):
+                x2 = _pad_x(x.reshape(rows, n), n, np_)
+                layer = qt.layer if isinstance(qt, QLayerView) else None
+                out = _sharded_matmul(x2, qt_full, layer, kind, mesh, interp)
+                return out.reshape(*lead, d).astype(out_dtype)
+            key = (kind, np_, d, tp)
+            if key not in _FALLBACK_WARNED:
+                _FALLBACK_WARNED.add(key)
+                print(f"⚠️  q40: ({np_},{d}) kind={kind} not evenly shardable "
+                      f"over tp={tp}; using the XLA dequant path for this weight")
+            impl = "xla"
         else:
-            if len(qt.qpacked.shape) != 2:
-                raise ValueError(f"matmul needs a 2-D QTensor, got {qt.shape}")
-            out = _pallas_matmul(x2, qt.qpacked, qt.scales, interpret=interp)
-        return out.reshape(*lead, d).astype(out_dtype)
+            x2 = _pad_x(x.reshape(rows, n), n, np_)
+            if isinstance(qt, QLayerView):
+                out = _pallas_matmul_stacked(x2, qt.qt.qpacked, qt.qt.scales,
+                                             qt.layer, interpret=interp)
+            else:
+                if len(qt.qpacked.shape) != 2:
+                    raise ValueError(f"matmul needs a 2-D QTensor, got {qt.shape}")
+                out = _pallas_matmul(x2, qt.qpacked, qt.scales, interpret=interp)
+            return out.reshape(*lead, d).astype(out_dtype)
     if impl == "xla":
         if isinstance(qt, QLayerView):
             qt = qt.sliced()
@@ -333,9 +486,10 @@ def matmul(x: jax.Array, qt: QTensor | QLayerView, impl: str = "auto",
     raise ValueError(f"unknown q40 matmul impl {impl!r}")
 
 
-def mm(x: jax.Array, w, impl: str = "auto", out_dtype=None) -> jax.Array:
+def mm(x: jax.Array, w, impl: str = "auto", out_dtype=None,
+       kind: str | None = None) -> jax.Array:
     """Generic matmul: dispatches QTensor → fused path, array → plain dot."""
     if isinstance(w, (QTensor, QLayerView)):
-        return matmul(x, w, impl=impl, out_dtype=out_dtype)
+        return matmul(x, w, impl=impl, out_dtype=out_dtype, kind=kind)
     out = x @ w
     return out.astype(out_dtype) if out_dtype is not None else out

@@ -116,5 +116,29 @@ def place_params(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
     sharding to both pytree leaves (qpacked + scales, whose row counts are
     N/2 and N/32 — both divisible at block granularity).
     """
-    shardings = param_shardings(cfg, mesh)
-    return {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+    specs = param_specs(cfg)
+    out = {}
+    for k, v in params.items():
+        if not _spec_divides(v, specs[k], mesh):
+            # e.g. a Q40 scales plane (n/32 rows) that doesn't divide the
+            # mesh axis: keep the tensor replicated — q40.matmul makes the
+            # matching per-tensor fallback (_tp_shardable) at trace time
+            print(f"⚠️  sharding: {k} {jax.tree.leaves(v)[0].shape} does not "
+                  f"divide mesh {dict(mesh.shape)} evenly; replicating")
+            out[k] = jax.device_put(v, NamedSharding(mesh, REPL))
+        else:
+            out[k] = jax.device_put(v, NamedSharding(mesh, specs[k]))
+    return out
+
+
+def _spec_divides(v, spec: P, mesh: Mesh) -> bool:
+    """True if every leaf of ``v`` shards evenly under ``spec`` on ``mesh``."""
+    for leaf in jax.tree.leaves(v):
+        for dim, axes in zip(leaf.shape, spec):
+            if axes is None:
+                continue
+            for ax in (axes if isinstance(axes, tuple) else (axes,)):
+                n = mesh.shape[ax]
+                if dim % n:
+                    return False
+    return True

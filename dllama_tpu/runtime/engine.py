@@ -43,6 +43,31 @@ def _next_bucket(n: int, minimum: int = 16) -> int:
     return b
 
 
+def _unfuse(params: Params, cfg: ModelConfig) -> Params:
+    """Split fused ``wqkv``/``w13`` tensors into per-projection weights for
+    tensor-parallel placement (the fused layout is a single-chip launch
+    optimization; its concat axis does not align with TP shard boundaries)."""
+    from ..ops import q40
+
+    def split(w, sizes):
+        if isinstance(w, q40.QTensor):
+            return q40.split_d(w, sizes)
+        off, out = 0, []
+        for s in sizes:
+            out.append(w[..., :, off:off + s])
+            off += s
+        return out
+
+    p = dict(params)
+    if "wqkv" in p:
+        dh = cfg.head_size
+        p["wq"], p["wk"], p["wv"] = split(
+            p.pop("wqkv"), [cfg.n_heads * dh, cfg.n_kv_heads * dh, cfg.n_kv_heads * dh])
+    if "w13" in p:
+        p["w1"], p["w3"] = split(p.pop("w13"), [cfg.hidden_dim, cfg.hidden_dim])
+    return p
+
+
 @dataclass
 class StepStats:
     """Per-token timing, reference benchmark-mode contract (dllama.cpp:74-82)."""
@@ -87,19 +112,17 @@ class Engine:
         tp = self.mesh.shape.get("tp", 1)
         if tp > 1:
             sharding.check_tp_constraint(cfg, tp)
-        # Packed-Q40 matmul dispatch: the fused Pallas kernel is a single-
-        # device program (GSPMD cannot partition a pallas_call), so under a
-        # tp>1 mesh force the partitionable XLA emulation; a caller's
-        # explicit single-chip choice (e.g. "xla" for numerics debugging)
-        # is respected.
-        if tp > 1 and cfg.quant_impl in ("auto", "pallas"):
-            cfg = cfg.with_(quant_impl="xla")
+            # the fused wqkv/w13 concat axis mixes q/k/v shard ranges under
+            # tp — split back into per-projection tensors whose output axes
+            # shard cleanly (RowMatmulSlice boundaries, commands.cpp:8-40)
+            params = _unfuse(params, cfg)
+        # Packed-Q40 matmul dispatch on a multi-device mesh runs the fused
+        # Pallas kernel per shard under shard_map (ops/q40.py
+        # _sharded_matmul) — no downgrade; weights whose shapes don't
+        # divide the mesh evenly fall back per-tensor inside q40.matmul.
         self.sp = self.mesh.shape.get("sp", 1)
-        if self.sp > 1:
-            if self.seq_len % self.sp:
-                raise ValueError(f"seq_len {self.seq_len} not divisible by sp={self.sp}")
-            if cfg.quant_impl in ("auto", "pallas"):
-                cfg = cfg.with_(quant_impl="xla")  # multi-device program
+        if self.sp > 1 and self.seq_len % self.sp:
+            raise ValueError(f"seq_len {self.seq_len} not divisible by sp={self.sp}")
         self.cfg = cfg
         self.params = sharding.place_params(params, cfg, self.mesh)
         # sp>1 shards the cache's sequence axis: max context scales with

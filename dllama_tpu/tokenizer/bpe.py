@@ -66,8 +66,11 @@ class Tokenizer:
             if tid != -1:
                 tokens.append(tid)
             else:
-                # byte fallback: vocab ids 3.. are the raw bytes (tokenizer.cpp:250-253)
-                tokens.extend(b + 3 for b in chunk)
+                # byte fallback: vocab ids 3.. are the raw bytes (tokenizer.cpp:
+                # 250-253).  The reference indexes b+3 unconditionally — UB when
+                # the vocab has no byte pieces; emit <unk> (id 0) instead.
+                tokens.extend(b + 3 if b + 3 < len(self.vocab) else 0
+                              for b in chunk)
             i = j
 
         # greedy merge of the best-scoring adjacent pair (tokenizer.cpp:258-287)

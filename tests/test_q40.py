@@ -93,6 +93,121 @@ class TestMatmul:
         np.testing.assert_allclose(np.asarray(q40.mm(x, w)), np.asarray(x @ w),
                                    rtol=1e-6, atol=1e-6)
 
+    def test_split_d_unfuse(self):
+        """split_d (the tp>1 unfuse of wqkv/w13) ≡ quantizing the pieces."""
+        w = _rand((2, 64, 96), seed=5)
+        qt = q40.quantize(w)
+        a, b = q40.split_d(qt, [64, 32])
+        np.testing.assert_array_equal(
+            np.asarray(q40.dequantize(a)), np.asarray(q40.dequantize(qt))[..., :64])
+        np.testing.assert_array_equal(
+            np.asarray(q40.dequantize(b)), np.asarray(q40.dequantize(qt))[..., 64:])
+        assert a.logical_nd == (64, 64) and b.logical_nd == (64, 32)
+
+
+class TestShardMap:
+    """The fused kernel per-shard under shard_map (VERDICT r01 #2): the
+    tp>1 production path must be the pallas kernel, not the XLA emulation.
+    Interpret mode stands in for Mosaic on the CPU test mesh."""
+
+    def _mesh(self, tp):
+        from dllama_tpu.parallel.mesh import make_mesh
+        if len(jax.devices()) < tp:
+            pytest.skip(f"needs {tp} devices")
+        return make_mesh(tp=tp, devices=jax.devices()[:tp])
+
+    def test_row_sharded_matmul(self):
+        from dllama_tpu.parallel.mesh import active_mesh
+        w = _rand((512, 256), seed=7)
+        x = _rand((2, 512), seed=8, scale=1.0)
+        qt = q40.quantize(w)
+        ref = np.asarray(q40.matmul(jnp.asarray(x), qt, impl="xla"))
+        mesh = self._mesh(8)
+        with active_mesh(mesh):
+            out = np.asarray(q40.matmul(jnp.asarray(x), qt,
+                                        impl="pallas_interpret", kind="row"))
+        np.testing.assert_allclose(out, ref, rtol=0, atol=2e-2 * np.abs(ref).max())
+
+    def test_col_sharded_matmul_psums_partials(self):
+        from dllama_tpu.parallel.mesh import active_mesh
+        w = _rand((512, 192), seed=9)
+        x = _rand((2, 512), seed=10, scale=1.0)
+        qt = q40.quantize(w)
+        ref = np.asarray(q40.matmul(jnp.asarray(x), qt, impl="xla"))
+        mesh = self._mesh(8)
+        with active_mesh(mesh):
+            out = np.asarray(q40.matmul(jnp.asarray(x), qt,
+                                        impl="pallas_interpret", kind="col"))
+        np.testing.assert_allclose(out, ref, rtol=0, atol=2e-2 * np.abs(ref).max())
+
+    def test_unshardable_falls_back_to_xla(self):
+        """A weight whose blocks don't divide the mesh must still compute
+        correctly (per-tensor XLA fallback, not an error)."""
+        from dllama_tpu.parallel.mesh import active_mesh
+        w = _rand((64, 48), seed=11)          # 2 blocks: not col-shardable over 8
+        x = _rand((1, 64), seed=12, scale=1.0)
+        qt = q40.quantize(w)
+        ref = np.asarray(q40.matmul(jnp.asarray(x), qt, impl="xla"))
+        with active_mesh(self._mesh(8)):
+            out = np.asarray(q40.matmul(jnp.asarray(x), qt,
+                                        impl="pallas_interpret", kind="col"))
+        np.testing.assert_allclose(out, ref, rtol=0, atol=2e-2 * np.abs(ref).max())
+
+    def test_sp_mesh_keeps_fused_pallas_path(self):
+        """On an sp>1, tp=1 mesh the fused wqkv/w13 stay fused and run the
+        pallas kernel replicated under shard_map (no XLA downgrade)."""
+        from dllama_tpu.models.config import tiny_config
+        from dllama_tpu.models.params import init_params, quantize_matmuls
+        from dllama_tpu.parallel.mesh import make_mesh
+        from dllama_tpu.runtime.engine import Engine
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        cfg = tiny_config(dim=64, hidden_dim=96, n_layers=2, n_heads=4,
+                          n_kv_heads=2, vocab_size=128, seq_len=64,
+                          ).with_(quant_impl="pallas_interpret")
+        params = quantize_matmuls(init_params(cfg, seed=3), cfg)
+        e1 = Engine(cfg, params, mesh=make_mesh(tp=1, devices=jax.devices()[:1]))
+        esp = Engine(cfg, params, mesh=make_mesh(tp=1, sp=2, devices=jax.devices()[:2]))
+        assert "wqkv" in esp.params  # fused layout kept on a tp=1 mesh
+        l1, _ = e1.prefill([5, 9, 2])
+        lsp, _ = esp.prefill([5, 9, 2])
+        np.testing.assert_allclose(l1, lsp, atol=1e-3 + 1e-3 * np.abs(l1).max(), rtol=0)
+
+    def test_tp8_engine_pallas_matches_tp1(self):
+        """End-to-end: a tp=8 engine on the pallas(-interpret) path produces
+        the same logits and greedy tokens as tp=1 — the VERDICT r01 done-
+        criterion for the fused kernel under tensor parallelism."""
+        from dllama_tpu.models.config import tiny_config
+        from dllama_tpu.models.params import init_params, quantize_matmuls
+        from dllama_tpu.parallel.mesh import make_mesh
+        from dllama_tpu.runtime.engine import Engine
+        from dllama_tpu.sampling import Sampler
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices")
+        # shapes chosen to divide an 8-way mesh at Q40 block granularity
+        cfg = tiny_config(dim=256, hidden_dim=256, n_layers=2, n_heads=8,
+                          n_kv_heads=8, vocab_size=128, seq_len=64,
+                          ).with_(quant_impl="pallas_interpret")
+        params = quantize_matmuls(init_params(cfg, seed=4), cfg)
+        prompt = [3, 17, 29, 5]
+
+        e1 = Engine(cfg, params, mesh=make_mesh(tp=1, devices=jax.devices()[:1]))
+        e8 = Engine(cfg, params, mesh=make_mesh(tp=8))
+        assert "wq" in e8.params and "wqkv" not in e8.params  # unfused for tp
+        l1, _ = e1.prefill(prompt)
+        l8, _ = e8.prefill(prompt)
+        np.testing.assert_allclose(l1, l8, atol=1e-3 + 1e-3 * np.abs(l1).max(), rtol=0)
+
+        def greedy(engine):
+            s = Sampler(cfg.vocab_size, 0.0, 0.9, 1)
+            return [t for t, _ in engine.generate(prompt, 16, s)]
+
+        t1 = greedy(Engine(cfg, params, mesh=make_mesh(tp=1, devices=jax.devices()[:1])))
+        t8 = greedy(Engine(cfg, params, mesh=make_mesh(tp=8)))
+        assert t1 == t8
+
 
 class TestModel:
     def test_quantized_forward_close_to_dense(self):
