@@ -1,51 +1,76 @@
 """Benchmark: single-token decode throughput on real TPU hardware.
 
-Prints ONE JSON line:
+Prints exactly ONE JSON line on stdout:
     {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N}
 
 Mirrors the reference's benchmark mode (`dllama inference`,
-dllama.cpp:45-93): average per-token generation time over a decode loop.
-Baseline for comparison is the reference's best published single-node
-Llama-2-7B Q40 number — 101.81 ms/token (9.82 tok/s) on a c3d-highcpu-30
-VM (README.md:126, BASELINE.md) — since multi-chip hardware is not
-reachable from this harness (one v5e chip via the axon tunnel).
+dllama.cpp:45-93): average per-token generation time over a greedy decode
+loop.  Baseline is the reference's best published single-node Llama-2-7B
+Q40 number — 101.81 ms/token = 9.82 tok/s on a c3d-highcpu-30 VM
+(README.md:126, BASELINE.md) — since multi-chip hardware is not reachable
+from this harness (one v5e chip via the axon tunnel).
 
-The benched path is the production one: packed-Q40 weights in HBM, the
-fused Pallas dequant-matmul (ops/q40.py), and the on-device K-step
-generation loop (runtime/decode_loop.py) — sampling included, only token
-ids cross to the host.  Weights are zero-valued (built directly as packed
-buffers): decode timing is value-independent, and materializing 7B f32
-weights on host would need ~27 GB RAM.  Falls back to TinyLlama-1.1B
-shapes if the 7B working set does not fit the chip.
+Architecture (hardened after r01, where a hanging backend init burned the
+whole window and produced no JSON at all): a parent orchestrator spawns
+each stage as a subprocess with a hard timeout under a global wall-clock
+budget (env BENCH_BUDGET_S, default 1500 s) —
+
+  1. backend probe: `jax.devices()` only; bounded, so a wedged TPU tunnel
+     costs minutes, not the session;
+  2. llama2-7b Q40 greedy decode on the TPU (the config with a published
+     reference number), preceded by an in-process pallas-vs-XLA hardware
+     equality check on the fused kernel;
+  3. tinyllama-1.1b fallback if the 7B working set fails;
+  4. degraded CPU fallback (tiny shapes, vs_baseline null) so the driver
+     always records a parsed line even with the TPU unreachable.
+
+The timing loop is greedy (temperature 0 → on-device argmax): sampling
+cost is not the metric the baseline measures (the reference samples on
+host between steps; its published ms/token is dominated by the matmuls).
+Weights are zero-valued packed buffers: decode timing is value-independent
+and 7B f32 host materialization (~27 GB) is avoided.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "420"))
+BASELINE_7B_TOKS = 9.82  # README.md:126 — 101.81 ms/token, 1× c3d-highcpu-30
 
 
-def model_cfgs():
+# ---------------------------------------------------------------------------
+# Child attempts (run in a subprocess; last stdout line is a JSON object)
+# ---------------------------------------------------------------------------
+
+def _model_cfg(name):
+    import jax.numpy as jnp
     from dllama_tpu.models.config import tiny_config
-    # llama-2-7b shapes (README.md:102/126 measurement target)
-    llama7b = tiny_config(dim=4096, hidden_dim=11008, n_layers=32, n_heads=32,
-                          n_kv_heads=32, vocab_size=32000, seq_len=1024,
-                          dtype=jnp.bfloat16)
-    # tinyllama-1.1b (launch.py:7)
-    tiny11 = tiny_config(dim=2048, hidden_dim=5632, n_layers=22, n_heads=32,
-                         n_kv_heads=4, vocab_size=32000, seq_len=2048,
-                         dtype=jnp.bfloat16)
-    return [("llama2-7b", llama7b, 9.82), ("tinyllama-1.1b", tiny11, None)]
+    if name == "llama2-7b":
+        # README.md:102/126 measurement target shapes
+        return tiny_config(dim=4096, hidden_dim=11008, n_layers=32, n_heads=32,
+                           n_kv_heads=32, vocab_size=32000, seq_len=1024,
+                           dtype=jnp.bfloat16)
+    if name == "tinyllama-1.1b":  # launch.py:7
+        return tiny_config(dim=2048, hidden_dim=5632, n_layers=22, n_heads=32,
+                           n_kv_heads=4, vocab_size=32000, seq_len=2048,
+                           dtype=jnp.bfloat16)
+    if name == "cpu-tiny":
+        return tiny_config(dim=512, hidden_dim=1408, n_layers=4, n_heads=8,
+                           n_kv_heads=8, vocab_size=4096, seq_len=256,
+                           dtype=jnp.float32)
+    raise ValueError(name)
 
 
-def zero_q40_params(cfg):
+def _zero_q40_params(cfg):
     """Params with packed-Q40 matmul weights, built as zero device buffers
     (no host-side f32 materialization)."""
+    import jax.numpy as jnp
     from dllama_tpu.models.params import param_shapes
     from dllama_tpu.ops.q40 import QTensor, padded_n
 
@@ -71,22 +96,58 @@ def zero_q40_params(cfg):
     return params
 
 
-def bench_decode(cfg, chunk=64, n_chunks=4):
+def _pallas_hw_check():
+    """Non-interpret fused-kernel equality check on the real backend
+    (VERDICT r01: Mosaic breakage must be visible in the artifact).
+    Returns 'pallas' if the fused kernel is usable, else 'xla'."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from dllama_tpu.ops import q40
+
+    if jax.default_backend() == "cpu":
+        return "xla"
+    try:
+        rng = np.random.RandomState(0)
+        w = (rng.randn(2048, 512) * 0.1).astype(np.float32)
+        x = jnp.asarray(rng.randn(1, 2048).astype(np.float32), jnp.bfloat16)
+        qt = q40.quantize(w)
+        out_p = np.asarray(q40.matmul(x, qt, impl="pallas"))
+        out_x = np.asarray(q40.matmul(x, qt, impl="xla"))
+        err = float(np.max(np.abs(out_p - out_x)) / (np.max(np.abs(out_x)) + 1e-9))
+        if err > 2e-2:
+            raise AssertionError(f"pallas/xla mismatch, rel err {err:.3g}")
+        print(f"pallas hardware check: OK (max rel err {err:.2e})", file=sys.stderr)
+        return "pallas"
+    except Exception as e:
+        print(f"pallas hardware check FAILED ({type(e).__name__}: {str(e)[:160]}); "
+              "benching the XLA dequant path", file=sys.stderr)
+        return "xla"
+
+
+def _bench_decode(cfg, chunk=32, n_chunks=3):
+    """Greedy on-device decode loop; returns avg ms/token over the timed
+    chunks (compile + warmup excluded)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
     from dllama_tpu.models.transformer import init_kv_cache
     from dllama_tpu.runtime.decode_loop import decode_chunk
 
-    params = zero_q40_params(cfg)
+    params = _zero_q40_params(cfg)
     cache = init_kv_cache(cfg, batch=1)
 
     fn = jax.jit(
         lambda p, c, tok, pos, k: decode_chunk(
-            p, cfg, c, tok, pos, k, steps=chunk, temperature=0.8, topp=0.9),
+            p, cfg, c, tok, pos, k, steps=chunk, temperature=0.0, topp=0.9),
         donate_argnums=(1,))
 
     tok = jnp.zeros((1,), jnp.int32)
     key = jax.random.PRNGKey(0)
-    toks, cache, tok, _, _ = fn(params, cache, tok, jnp.int32(0), key)  # warmup/compile
+    t0 = time.perf_counter()
+    toks, cache, tok, _, _ = fn(params, cache, tok, jnp.int32(0), key)  # compile+warmup
     np.asarray(toks)
+    print(f"compile+warmup: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     times = []
     for i in range(n_chunks):
@@ -97,28 +158,111 @@ def bench_decode(cfg, chunk=64, n_chunks=4):
     return float(np.mean(times))
 
 
+def run_attempt(name):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import jax
+
+    if name == "probe":
+        devs = jax.devices()
+        print(json.dumps({"platform": jax.default_backend(),
+                          "devices": [str(d) for d in devs]}))
+        return
+
+    cfg = _model_cfg(name)
+    if name == "cpu-tiny":
+        impl, chunk, n_chunks = "xla", 16, 2
+    else:
+        impl = _pallas_hw_check()
+        chunk, n_chunks = 32, 3
+    cfg = cfg.with_(quant_impl=impl)
+    ms = _bench_decode(cfg, chunk=chunk, n_chunks=n_chunks)
+    toks = 1000.0 / ms
+    backend = jax.default_backend()
+    if name == "llama2-7b":
+        metric = f"llama2-7b q40 greedy decode tok/s (1 TPU chip, {impl})"
+        vs = round(toks / BASELINE_7B_TOKS, 2)
+    elif name == "tinyllama-1.1b":
+        metric = f"tinyllama-1.1b q40 greedy decode tok/s (1 TPU chip, {impl})"
+        vs = None  # no published reference number for this config
+    else:
+        metric = "DEGRADED cpu-fallback tiny-llama decode tok/s (TPU unreachable)"
+        vs = None
+    print(json.dumps({"metric": metric, "value": round(toks, 2),
+                      "unit": "tok/s", "vs_baseline": vs, "backend": backend}))
+
+
+# ---------------------------------------------------------------------------
+# Parent orchestrator
+# ---------------------------------------------------------------------------
+
+def _spawn(name, timeout_s, env_extra=None):
+    """Run one attempt in a subprocess; returns its parsed JSON or None.
+    Stderr is inherited so progress lands in the driver log."""
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    t0 = time.time()
+    print(f"bench: attempt {name} (timeout {timeout_s:.0f}s)", file=sys.stderr)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--attempt", name],
+            stdout=subprocess.PIPE, timeout=timeout_s, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+    except subprocess.TimeoutExpired:
+        print(f"bench: {name} timed out after {time.time() - t0:.0f}s", file=sys.stderr)
+        return None
+    if r.returncode != 0:
+        print(f"bench: {name} exited rc={r.returncode}", file=sys.stderr)
+        return None
+    try:
+        line = r.stdout.decode().strip().splitlines()[-1]
+        out = json.loads(line)
+        print(f"bench: {name} ok in {time.time() - t0:.0f}s: {line}", file=sys.stderr)
+        return out
+    except Exception as e:
+        print(f"bench: {name} unparseable output ({e})", file=sys.stderr)
+        return None
+
+
+def _emit(result):
+    result.pop("backend", None)
+    print(json.dumps(result))
+
+
 def main():
-    last_err = None
-    for name, cfg, baseline_toks in model_cfgs():
-        try:
-            ms = bench_decode(cfg)
-            toks = 1000.0 / ms
-            # only compare against a published reference number for the same
-            # model; the fallback has none, so its vs_baseline is null
-            vs = round(toks / baseline_toks, 2) if baseline_toks else None
-            print(json.dumps({
-                "metric": f"{name} q40 decode tok/s (1 TPU v5e chip, fused pallas)",
-                "value": round(toks, 2),
-                "unit": "tok/s",
-                "vs_baseline": vs,
-            }))
-            return
-        except Exception as e:  # OOM etc. — try the smaller model
-            last_err = e
-            print(f"bench: {name} failed ({type(e).__name__}: {str(e)[:120]}), "
-                  "falling back", file=sys.stderr)
-    raise SystemExit(f"all bench configs failed: {last_err}")
+    t_start = time.time()
+
+    def remaining():
+        return BUDGET_S - (time.time() - t_start)
+
+    cpu_env = {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"}
+
+    probe = _spawn("probe", min(PROBE_TIMEOUT_S, max(remaining() - 420, 60)))
+    on_hw = probe is not None and probe.get("platform") != "cpu"
+
+    if on_hw:
+        for name in ("llama2-7b", "tinyllama-1.1b"):
+            budget = remaining() - 360  # keep room for the CPU fallback
+            if budget < 180:
+                print("bench: budget exhausted, skipping to fallback", file=sys.stderr)
+                break
+            out = _spawn(name, min(budget, 1200))
+            if out:
+                _emit(out)
+                return
+    else:
+        print("bench: TPU backend unreachable — degraded CPU mode", file=sys.stderr)
+
+    out = _spawn("cpu-tiny", max(min(remaining() - 30, 420), 120), env_extra=cpu_env)
+    if out:
+        _emit(out)
+        return
+    # absolute last resort: still print a parseable line
+    _emit({"metric": "bench failed (no backend produced a number)",
+           "value": 0.0, "unit": "tok/s", "vs_baseline": None})
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 2 and sys.argv[1] == "--attempt":
+        run_attempt(sys.argv[2])
+    else:
+        main()

@@ -52,8 +52,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=None)          # time-based default (app.cpp:33)
     p.add_argument("--weights-float-type", choices=list(quants.FLOAT_TYPE_BY_NAME),
                    default=None, help="required for legacy .m files without a header key")
-    p.add_argument("--buffer-float-type", choices=list(DTYPES), default="bf16",
-                   help="compute dtype (the reference's wire/buffer quantization analogue)")
+    p.add_argument("--buffer-float-type", choices=list(DTYPES) + ["q80"], default="bf16",
+                   help="compute dtype (the reference's wire/buffer quantization "
+                        "analogue); 'q80' is accepted for reference-command parity "
+                        "and maps to bf16 (Q80's purpose is wire compression, "
+                        "tasks.cpp:124-163 — the 'wire' here is ICI inside the "
+                        "XLA program)")
     p.add_argument("--workers", default=None, help="tpu:N mesh degree")
     p.add_argument("--max-seq-len", type=int, default=None)
     p.add_argument("--kv-cache-dtype", choices=list(DTYPES), default=None)
@@ -61,8 +65,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dequantize", action="store_true",
                    help="load Q40 weights as dense bf16 instead of the packed "
                         "fused-kernel path (debugging / numerics comparison)")
+    p.add_argument("--profile-split", action="store_true",
+                   help="inference mode: after the run, trace a few decode steps "
+                        "with the XLA profiler and report compute vs collective "
+                        "time (the reference's I/T split, SURVEY §5-tracing)")
     p.add_argument("--nthreads", type=int, default=0, help="accepted for reference CLI parity; unused on TPU")
-    p.add_argument("--port", type=int, default=9990)
+    p.add_argument("--port", type=int, default=9990,
+                   help="accepted for reference CLI parity; only the API server "
+                        "(python -m dllama_tpu.server.api) listens on it")
     return p
 
 
@@ -72,7 +82,12 @@ def load_stack(args) -> tuple[Engine, Tokenizer]:
         raise SystemExit("--model and --tokenizer are required for this mode")
     wft = quants.FLOAT_TYPE_BY_NAME[args.weights_float_type] if args.weights_float_type else None
     mf = mfile.MFile(args.model, weights_ftype=wft)
-    dtype = jnp.dtype(DTYPES[args.buffer_float_type])
+    bft = args.buffer_float_type
+    if bft == "q80":
+        print("💡 bufferFloatType q80 → bf16 (activations stay on-chip; Q80's "
+              "wire compression has no wire to compress here)")
+        bft = "bf16"
+    dtype = jnp.dtype(DTYPES[bft])
     cfg = ModelConfig.from_spec(mf.spec, dtype=dtype)
     print(f"💡 arch: {mf.spec.arch_name}")
     print(f"💡 dim: {cfg.dim}\n💡 nLayers: {cfg.n_layers}\n💡 nHeads: {cfg.n_heads}")
@@ -113,13 +128,32 @@ def cmd_inference(args) -> None:
         if st.generation_ms > 0:
             stats.add(st)
         print(f"🔶 G {st.generation_ms:7.2f} ms I {st.inference_ms:7.2f} ms "
-              f"T {st.transfer_ms:6.2f} ms | {piece!r}")
+              f"T {st.transfer_ms:6.2f} ms S {st.sent_bytes / 1024:6.1f} kB "
+              f"R {st.recv_bytes / 1024:6.1f} kB | {piece!r}")
         pieces.append(piece)
     print(f"Generated tokens:    {len(stats.tokens)}")
     print(f"Avg tokens / second: {stats.tokens_per_second:.2f}")
     print(f"Avg generation time: {stats.avg_generation_ms:.2f} ms")
     print(f"Avg inference time:  {stats.avg_inference_ms:.2f} ms")
     print(f"Avg transfer time:   {stats.avg_transfer_ms:.2f} ms")
+    print(f"Avg sent / recv:     {stats.avg_sent_bytes / 1024:.1f} kB / "
+          f"{stats.avg_recv_bytes / 1024:.1f} kB")
+
+    if args.profile_split:
+        from .runtime.profiling import profiled_split
+        if engine.pos + 4 > engine.seq_len:
+            engine.reset()
+            engine.prefill(ids)
+        last = ids[-1]
+        split = profiled_split(lambda: engine.decode_one(last), steps=3)
+        if split is None:
+            print("Profiled split:      unavailable (xplane tooling missing)")
+        else:
+            n_dev = engine.mesh.size
+            print(f"Profiled decode step (mesh sum / {n_dev} devices): "
+                  f"compute {split['compute_ms']:.2f} ms, "
+                  f"collectives {split['collective_ms']:.2f} ms "
+                  f"({split['collective_pct']:.1f}%)")
 
 
 def cmd_generate(args) -> None:

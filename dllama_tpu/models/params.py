@@ -100,8 +100,10 @@ def quantize_matmuls(params: Params, cfg: ModelConfig,
                      fuse: bool = True) -> Params:
     """Convert the dense matmul weights of a params pytree to packed Q40
     (host-side).  Used by benchmarks/tests to exercise the quantized path
-    from randomly-initialized params; MoE expert tensors and the embedding
-    stay dense (expert dispatch needs gatherable arrays).
+    from randomly-initialized params.  MoE expert stacks quantize too
+    (``(L, E, n, d)`` → blocks along the input axis, the reference keeps
+    experts Q40 end-to-end, transformer.cpp:299-317); the router and the
+    embedding stay dense.
 
     ``fuse=True`` additionally concatenates q/k/v (and w1/w3) output dims
     into single ``wqkv``/``w13`` tensors — see load_params."""
@@ -120,9 +122,34 @@ def quantize_matmuls(params: Params, cfg: ModelConfig,
         keys = ["wq", "wk", "wv", "wo", "wcls"]
         if not cfg.is_moe:
             keys += ["w1", "w2", "w3"]
+    if cfg.is_moe:
+        keys += ["up", "gate", "down"]
     for k in keys:
         out[k] = q40.quantize(np.asarray(params[k], np.float32))
     return out
+
+
+def _stack_q_experts(mf: mfile.MFile, cfg: ModelConfig, fname: str) -> q40.QTensor:
+    """Layer×expert-stacked packed-Q40 expert weights, filled tensor by
+    tensor into preallocated host arrays — no f32 materialization and no
+    transient double-buffering, so host RAM transit is bounded by the
+    packed size (~0.69 B/weight).  Replaces the dense f32 expert loading
+    that made Mixtral-8x7B (~90 GB f32 transit) unloadable (VERDICT r01)."""
+    L, E = cfg.n_layers, cfg.n_experts
+    first = q40.pack_planes_np(
+        *(np.swapaxes(p, -1, -2) for p in mf.q40_planes(f"layers.0.experts.0.{fname}")))
+    qp0, sc0, nd = first
+    qp = np.empty((L, E) + qp0.shape, np.uint8)
+    sc = np.empty((L, E) + sc0.shape, np.float32)
+    for l in range(L):
+        for e in range(E):
+            if l == 0 and e == 0:
+                qp[0, 0], sc[0, 0] = qp0, sc0
+                continue
+            planes = mf.q40_planes(f"layers.{l}.experts.{e}.{fname}")
+            qp[l, e], sc[l, e], _ = q40.pack_planes_np(
+                *(np.swapaxes(p, -1, -2) for p in planes))
+    return q40.QTensor(jnp.asarray(qp), jnp.asarray(sc), nd)
 
 
 def load_params(mf: mfile.MFile, cfg: ModelConfig | None = None,
@@ -169,13 +196,17 @@ def load_params(mf: mfile.MFile, cfg: ModelConfig | None = None,
     p["rms_ffn"] = _stack(mf, [f"layers.{i}.rms_ffn" for i in range(L)], False, np.float32)
     if cfg.is_moe:
         p["router"] = _stack(mf, [f"layers.{i}.moe_router" for i in range(L)], True, np_dtype)
-        for key, fname in [("up", "up"), ("gate", "gate"), ("down", "down")]:
-            per_layer = []
-            for i in range(L):
-                mats = [np.ascontiguousarray(mf.tensor(f"layers.{i}.experts.{e}.{fname}").T)
-                        for e in range(cfg.n_experts)]
-                per_layer.append(np.stack(mats))
-            p[key] = np.stack(per_layer).astype(np_dtype)
+        if quant:
+            for key in ("up", "gate", "down"):
+                p[key] = _stack_q_experts(mf, cfg, key)
+        else:
+            for key, fname in [("up", "up"), ("gate", "gate"), ("down", "down")]:
+                per_layer = []
+                for i in range(L):
+                    mats = [np.ascontiguousarray(mf.tensor(f"layers.{i}.experts.{e}.{fname}").T)
+                            for e in range(cfg.n_experts)]
+                    per_layer.append(np.stack(mats))
+                p[key] = np.stack(per_layer).astype(np_dtype)
         if cfg.post_block_norms:
             p["rms_moe"] = _stack(mf, [f"layers.{i}.rms_moe" for i in range(L)], False, np.float32)
             p["rms_ffn2"] = _stack(mf, [f"layers.{i}.rms_ffn2" for i in range(L)], False, np.float32)

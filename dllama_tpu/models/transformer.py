@@ -120,10 +120,15 @@ def moe_ffn(xb2d: jax.Array, lp, cfg: ModelConfig) -> jax.Array:
     grok1-tasks.cpp:60-114).
 
     Two execution strategies, chosen statically by token count:
-    * decode (few tokens): gather the k selected experts' weights from HBM
-      — reads only k/E of the MoE bytes, which is what bounds decode.
-    * prefill (many tokens): run every expert densely on the MXU and mask —
-      regular shapes, no data-dependent gathers in the hot loop.
+    * decode (few tokens): compute only the k selected experts — with
+      packed-Q40 experts each (token, k) pair runs the fused dequant-
+      matmul on a ``QLayerView`` whose flat index selects the expert, so
+      HBM reads are bounded by the k active experts' *packed* bytes
+      (the reference likewise keeps MoE Q40 end-to-end,
+      transformer.cpp:299-317); dense experts use a gather + einsum.
+    * prefill (many tokens): run every expert and mask — regular shapes
+      on the MXU; quantized experts unroll a static expert loop so only
+      one expert's weights are dequantized at a time.
 
     Experts are TP-sliced like the reference (all experts on all shards,
     hidden dim sharded — transformer.cpp:299-317); expert-parallel layouts
@@ -133,12 +138,34 @@ def moe_ffn(xb2d: jax.Array, lp, cfg: ModelConfig) -> jax.Array:
     e, k = cfg.n_experts, cfg.n_active_experts
     act = ACTIVATIONS[cfg.hidden_act]
 
-    router_logits = xb2d.astype(jnp.float32) @ lp["router"].astype(jnp.float32)  # (N, E)
+    router = lp["router"]
+    router_logits = xb2d.astype(jnp.float32) @ router.astype(jnp.float32)  # (N, E)
     probs = softmax_f32(router_logits)
     top_vals, top_idx = jax.lax.top_k(probs, k)  # (N, k)
     weights = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
 
-    if n <= 4:  # decode path: gather selected experts' weights
+    quant = isinstance(lp["up"], (q40.QTensor, q40.QLayerView))
+
+    if n <= 4 and quant:
+        # decode, packed experts: per-(token, slot) fused matmuls on the
+        # selected expert's packed planes
+        outs = []
+        for i in range(n):
+            xi = xb2d[i:i + 1]
+            acc = jnp.zeros((1, d), jnp.float32)
+            for j in range(k):
+                sel = top_idx[i, j]
+                up = lp["up"].select(sel, e)
+                gate = lp["gate"].select(sel, e)
+                down = lp["down"].select(sel, e)
+                h = act(_mm(xi, gate, cfg, kind="row")) * _mm(xi, up, cfg, kind="row")
+                o = q40.mm(h, down, impl=cfg.quant_impl, kind="col",
+                           out_dtype=jnp.float32)
+                acc = acc + weights[i, j] * o
+            outs.append(acc)
+        return jnp.concatenate(outs, 0).astype(cfg.dtype)
+
+    if n <= 4 and not quant:  # decode path: gather selected experts' weights
         up_w = jnp.take(lp["up"], top_idx, axis=0)      # (N, k, D, F)
         gate_w = jnp.take(lp["gate"], top_idx, axis=0)  # (N, k, D, F)
         down_w = jnp.take(lp["down"], top_idx, axis=0)  # (N, k, F, D)
@@ -146,11 +173,27 @@ def moe_ffn(xb2d: jax.Array, lp, cfg: ModelConfig) -> jax.Array:
         out = jnp.einsum("nkf,nkfd->nkd", h, down_w)
         return jnp.einsum("nk,nkd->nd", weights.astype(out.dtype), out)
 
+    dense_w = jnp.zeros((n, e), weights.dtype)
+    dense_w = jnp.put_along_axis(dense_w, top_idx, weights, axis=-1, inplace=False)
+
+    if quant:
+        # prefill, packed experts: static unroll — one expert dequantized
+        # at a time, masked accumulate
+        out = jnp.zeros((n, d), jnp.float32)
+        for ei in range(e):
+            idx = jnp.int32(ei)
+            up = lp["up"].select(idx, e)
+            gate = lp["gate"].select(idx, e)
+            down = lp["down"].select(idx, e)
+            h = act(_mm(xb2d, gate, cfg, kind="row")) * _mm(xb2d, up, cfg, kind="row")
+            oe = q40.mm(h, down, impl=cfg.quant_impl, kind="col",
+                        out_dtype=jnp.float32)
+            out = out + dense_w[:, ei:ei + 1].astype(jnp.float32) * oe
+        return out.astype(cfg.dtype)
+
     # prefill path: dense dispatch over all experts
     h = act(jnp.einsum("nd,edf->nef", xb2d, lp["gate"])) * jnp.einsum("nd,edf->nef", xb2d, lp["up"])
     outs = jnp.einsum("nef,efd->ned", h, lp["down"])
-    dense_w = jnp.zeros((n, e), weights.dtype)
-    dense_w = jnp.put_along_axis(dense_w, top_idx, weights, axis=-1, inplace=False)
     return jnp.einsum("ne,ned->nd", dense_w.astype(outs.dtype), outs)
 
 

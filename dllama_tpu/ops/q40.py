@@ -104,10 +104,13 @@ class QTensor:
         return jnp.bfloat16
 
 
-def pack_planes(qvals: np.ndarray, scales: np.ndarray) -> QTensor:
+def pack_planes_np(qvals: np.ndarray, scales: np.ndarray
+                   ) -> tuple[np.ndarray, np.ndarray, tuple[int, int]]:
     """Pack int8 nibble values ``(..., n, d)`` in [-8, 7] + scales
-    ``(..., n/32, d)`` into the block-local device layout (padding the
-    input dim to ``padded_n``; padded scales are zero)."""
+    ``(..., n/32, d)`` into the block-local layout as **host numpy arrays**
+    (padding the input dim to ``padded_n``; padded scales are zero).
+    Returns ``(packed u8, scales f32, logical_nd)`` — the loader uses this
+    to fill preallocated stacks without device round trips."""
     *lead, n, d = qvals.shape
     np_ = padded_n(n)
     b = (qvals + 8).astype(np.uint8).reshape(*lead, n // 32, 32, d)
@@ -119,8 +122,13 @@ def pack_planes(qvals: np.ndarray, scales: np.ndarray) -> QTensor:
             [packed, np.zeros((*lead, (np_ - n) // 2, d), np.uint8)], axis=-2)
         scales = np.concatenate(
             [scales, np.zeros((*lead, (np_ - n) // 32, d), scales.dtype)], axis=-2)
-    return QTensor(jnp.asarray(packed), jnp.asarray(scales.astype(np.float32)),
-                   (n, d))
+    return packed, scales.astype(np.float32), (n, d)
+
+
+def pack_planes(qvals: np.ndarray, scales: np.ndarray) -> QTensor:
+    """Device-array wrapper over :func:`pack_planes_np`."""
+    packed, sc, nd = pack_planes_np(qvals, scales)
+    return QTensor(jnp.asarray(packed), jnp.asarray(sc), nd)
 
 
 def quantize(w: np.ndarray) -> QTensor:
@@ -303,25 +311,44 @@ def _pallas_matmul_stacked(x: jax.Array, qpacked: jax.Array, scales: jax.Array,
 
 @dataclass(frozen=True)
 class QLayerView:
-    """A traced view of one layer of a stacked QTensor.
+    """A traced view of one 2-D slice of a stacked QTensor.
 
     Created inside the model's layer loop (the ``lax.scan`` body) so the
     fused kernel can index the stacked HBM buffer directly instead of the
-    scan slicing out a per-layer copy.  Never crosses a jit boundary, so it
-    needs no pytree registration.
+    scan slicing out a per-layer copy.  ``layer`` is a **flat** index over
+    the flattened leading dims — a layer for ``(L, n/2, d)`` weights, or
+    ``layer·E + expert`` for ``(L, E, n/2, d)`` MoE expert stacks (the
+    flatten-reshape is a free bitcast; the kernel DMAs only the selected
+    expert's packed tiles, which is what bounds MoE decode reads to the
+    k active experts).  Never crosses a jit boundary, so it needs no
+    pytree registration.
     """
 
-    qt: QTensor            # stacked (L, n/2, d)
-    layer: jax.Array       # traced scalar index
+    qt: QTensor            # stacked (*lead, n/2, d)
+    layer: jax.Array       # traced flat index over the flattened lead dims
 
     @property
     def logical_nd(self):
         return self.qt.logical_nd
 
+    def select(self, sub: jax.Array, span: int) -> "QLayerView":
+        """Narrow to a sub-slice of the next leading dim (e.g. an expert):
+        flat index becomes ``layer·span + sub``."""
+        return QLayerView(self.qt, self.layer * span + sub)
+
+    def flat_planes(self) -> tuple[jax.Array, jax.Array]:
+        """qpacked/scales with all leading dims flattened to one."""
+        qp, s = self.qt.qpacked, self.qt.scales
+        if qp.ndim > 3:
+            qp = qp.reshape((-1,) + qp.shape[-2:])
+            s = s.reshape((-1,) + s.shape[-2:])
+        return qp, s
+
     def sliced(self) -> QTensor:
+        qp, s = self.flat_planes()
         return QTensor(
-            jax.lax.dynamic_index_in_dim(self.qt.qpacked, self.layer, 0, keepdims=False),
-            jax.lax.dynamic_index_in_dim(self.qt.scales, self.layer, 0, keepdims=False),
+            jax.lax.dynamic_index_in_dim(qp, self.layer, 0, keepdims=False),
+            jax.lax.dynamic_index_in_dim(s, self.layer, 0, keepdims=False),
             self.qt.logical_nd)
 
 
@@ -356,7 +383,8 @@ def _tp_shardable(np_: int, d: int, kind: str | None, tp: int) -> bool:
     return False
 
 
-def _sharded_matmul(x2: jax.Array, qt: QTensor, layer: jax.Array | None,
+def _sharded_matmul(x2: jax.Array, qp: jax.Array, s: jax.Array,
+                    layer: jax.Array | None,
                     kind: str, mesh, interp: bool) -> jax.Array:
     """Run the fused kernel per shard under ``shard_map``.
 
@@ -397,7 +425,7 @@ def _sharded_matmul(x2: jax.Array, qt: QTensor, layer: jax.Array | None,
             out = jax.lax.psum(out, "tp")
         return out
 
-    args = [x2, qt.qpacked, qt.scales] + ([layer] if stacked else [])
+    args = [x2, qp, s] + ([layer] if stacked else [])
     in_specs = [xspec, wspec, wspec] + ([P()] if stacked else [])
     return jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
                          out_specs=ospec, check_vma=False)(*args)
@@ -451,15 +479,20 @@ def matmul(x: jax.Array, qt: QTensor | QLayerView, impl: str = "auto",
 
     if impl in ("pallas", "pallas_interpret"):
         interp = impl == "pallas_interpret"
-        qt_full = qt.qt if isinstance(qt, QLayerView) else qt
-        np_ = qt_full.qpacked.shape[-2] * 2
+        if isinstance(qt, QLayerView):
+            qp3, s3 = qt.flat_planes()
+            layer = qt.layer
+        else:
+            if len(qt.qpacked.shape) != 2:
+                raise ValueError(f"matmul needs a 2-D QTensor, got {qt.shape}")
+            qp3, s3, layer = qt.qpacked, qt.scales, None
+        np_ = qp3.shape[-2] * 2
         mesh = _smap_mesh()
         if mesh is not None:
             tp = mesh.shape.get("tp", 1)
             if _tp_shardable(np_, d, kind, tp):
                 x2 = _pad_x(x.reshape(rows, n), n, np_)
-                layer = qt.layer if isinstance(qt, QLayerView) else None
-                out = _sharded_matmul(x2, qt_full, layer, kind, mesh, interp)
+                out = _sharded_matmul(x2, qp3, s3, layer, kind, mesh, interp)
                 return out.reshape(*lead, d).astype(out_dtype)
             key = (kind, np_, d, tp)
             if key not in _FALLBACK_WARNED:
@@ -469,13 +502,10 @@ def matmul(x: jax.Array, qt: QTensor | QLayerView, impl: str = "auto",
             impl = "xla"
         else:
             x2 = _pad_x(x.reshape(rows, n), n, np_)
-            if isinstance(qt, QLayerView):
-                out = _pallas_matmul_stacked(x2, qt.qt.qpacked, qt.qt.scales,
-                                             qt.layer, interpret=interp)
+            if layer is not None:
+                out = _pallas_matmul_stacked(x2, qp3, s3, layer, interpret=interp)
             else:
-                if len(qt.qpacked.shape) != 2:
-                    raise ValueError(f"matmul needs a 2-D QTensor, got {qt.shape}")
-                out = _pallas_matmul(x2, qt.qpacked, qt.scales, interpret=interp)
+                out = _pallas_matmul(x2, qp3, s3, interpret=interp)
             return out.reshape(*lead, d).astype(out_dtype)
     if impl == "xla":
         if isinstance(qt, QLayerView):

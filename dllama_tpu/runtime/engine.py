@@ -68,12 +68,25 @@ def _unfuse(params: Params, cfg: ModelConfig) -> Params:
     return p
 
 
+class ContextOverflow(ValueError):
+    """The requested tokens do not fit the engine's context window.
+
+    A dedicated type so the API server can map it to an HTTP 400 without
+    masking unrelated ValueErrors as client errors (ADVICE r01)."""
+
+
 @dataclass
 class StepStats:
-    """Per-token timing, reference benchmark-mode contract (dllama.cpp:74-82)."""
+    """Per-token timing + host↔device traffic, reference benchmark-mode
+    contract (dllama.cpp:74-82: G/I/T ms and sent/recv kB columns —
+    there the bytes are TCP traffic between nodes, socket.cpp:280-285;
+    on a TPU mesh inter-chip traffic rides ICI inside the XLA program, so
+    S/R count the only remaining boundary: host↔device transfers)."""
     generation_ms: float = 0.0  # G: total wall time for the token
     inference_ms: float = 0.0   # I: device execution
     transfer_ms: float = 0.0    # T: host<->device boundary
+    sent_bytes: int = 0         # S: host → device
+    recv_bytes: int = 0         # R: device → host
 
 
 @dataclass
@@ -94,6 +107,14 @@ class RunStats:
     @property
     def avg_transfer_ms(self):
         return float(np.mean([t.transfer_ms for t in self.tokens])) if self.tokens else 0.0
+
+    @property
+    def avg_sent_bytes(self):
+        return float(np.mean([t.sent_bytes for t in self.tokens])) if self.tokens else 0.0
+
+    @property
+    def avg_recv_bytes(self):
+        return float(np.mean([t.recv_bytes for t in self.tokens])) if self.tokens else 0.0
 
     @property
     def tokens_per_second(self):
@@ -160,6 +181,8 @@ class Engine:
         stats.inference_ms = (t1 - t0) * 1000
         stats.transfer_ms = (t2 - t1) * 1000
         stats.generation_ms = (t2 - t0) * 1000
+        stats.sent_bytes = tokens_np.nbytes + 8  # token ids + pos/last scalars
+        stats.recv_bytes = host_logits.nbytes
         return host_logits, stats
 
     def prefill(self, prompt_tokens: list[int]) -> tuple[np.ndarray, StepStats]:
@@ -168,7 +191,8 @@ class Engine:
         if n == 0:
             raise ValueError("empty prompt")
         if self.pos + n > self.seq_len:
-            raise ValueError(f"prompt of {n} exceeds seq_len {self.seq_len} at pos {self.pos}")
+            raise ContextOverflow(
+                f"prompt of {n} exceeds seq_len {self.seq_len} at pos {self.pos}")
         # the padded bucket must also fit the cache: dynamic_update_slice
         # clamps out-of-range starts *backwards*, which would silently
         # overwrite valid KV history near the end of context
@@ -182,7 +206,7 @@ class Engine:
     def decode_one(self, token: int) -> tuple[np.ndarray, StepStats]:
         """One autoregressive step at the current position."""
         if self.pos >= self.seq_len:
-            raise ValueError(f"position {self.pos} at seq_len limit {self.seq_len}")
+            raise ContextOverflow(f"position {self.pos} at seq_len limit {self.seq_len}")
         toks = np.full((self.batch, 1), token, np.int32)
         logits, stats = self._run(toks, 0)
         self.pos += 1
@@ -224,10 +248,18 @@ class Engine:
         if produced >= steps:
             return
 
-        sampler = Sampler(self.cfg.vocab_size, temperature, topp, seed)
-        token = int(sampler.sample(logits[0]))
+        # one RNG stream per generation: the first token samples from the
+        # fetched prefill logits with the *same* JAX counter-based PRNG the
+        # on-device chunks use (fold_in of the seed key), so a fixed seed
+        # corresponds to exactly one stream (ADVICE r01: previously token 1
+        # came from the host xorshift Sampler and the rest from JAX)
+        from .decode_loop import device_sample
+        sub = jax.random.fold_in(self._key, self._chunk_counter)
+        self._chunk_counter += 1
+        token = int(np.asarray(device_sample(
+            jnp.asarray(logits), sub, temperature, topp))[0])
         # prefill cost was already attributed to the last prompt token; this
-        # token only cost a host-side sample over fetched logits
+        # token only cost a sample over the fetched logits
         yield token, StepStats()
         produced += 1
         if token in eos_ids:
@@ -252,7 +284,9 @@ class Engine:
             per = StepStats(
                 generation_ms=(t2 - t0) * 1000 / k,
                 inference_ms=(t1 - t0) * 1000 / k,
-                transfer_ms=(t2 - t1) * 1000 / k)
+                transfer_ms=(t2 - t1) * 1000 / k,
+                sent_bytes=(self.batch * 4 + 8) // k,
+                recv_bytes=toks.nbytes // k)
             for j, tk in enumerate(toks.tolist()):
                 token = int(tk)
                 yield token, per

@@ -1,0 +1,93 @@
+"""Profiler-derived compute-vs-collective attribution.
+
+The reference's headline benchmark splits per-token time into I (inference)
+and T (transfer) using task-type wall-clock accounting in its scheduler
+(utils.cpp:189-192, printed at dllama.cpp:77-93).  On a TPU mesh the
+inter-chip hops are XLA collectives *inside* the compiled program, so the
+equivalent split needs the XLA profiler: this module traces a few engine
+steps with ``jax.profiler`` and classifies device-op time into collective
+vs compute from the xplane proto (SURVEY §5-tracing prescribes exactly
+this profiler-derived attribution).
+
+The heavy imports (tensorflow's xplane proto) happen lazily — profiling is
+an opt-in diagnostic (`dllama inference --profile-split`), not a hot-path
+dependency; without the proto available the caller gets ``None``.
+"""
+
+from __future__ import annotations
+
+import glob
+import re
+import tempfile
+from typing import Callable
+
+# XLA HLO collective primitives (the ICI traffic the reference counts as T)
+_COLLECTIVE = re.compile(
+    r"all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all",
+    re.IGNORECASE)
+# HLO op names are lowercase dotted/dashed identifiers (fusion.3, dot.1,
+# dynamic-update-slice); runtime/host events (Rendezvous, PjitFunction(...),
+# "Wait: ...") are not op time and are excluded.
+_HLO_NAME = re.compile(r"^[a-z][a-z0-9_.\-]*$")
+
+
+def _parse_xspace(path: str) -> tuple[float, float]:
+    """Returns (compute_ms, collective_ms) summed over all device planes."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2  # lazy, heavy
+
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    compute_ps = 0
+    collective_ps = 0
+    for plane in xs.planes:
+        # TPU op time lives in '/device:TPU:N' planes; the CPU backend logs
+        # ops into '/host:CPU'.  Skip pure-metadata planes.
+        if not (plane.name.startswith("/device:") or plane.name == "/host:CPU"):
+            continue
+        md = {m.id: m.name for m in plane.event_metadata.values()}
+        for line in plane.lines:
+            for ev in line.events:
+                name = md.get(ev.metadata_id, "")
+                if not _HLO_NAME.match(name):
+                    continue
+                if _COLLECTIVE.search(name):
+                    collective_ps += ev.duration_ps
+                else:
+                    compute_ps += ev.duration_ps
+    return compute_ps / 1e9, collective_ps / 1e9
+
+
+def profiled_split(step: Callable[[], None], steps: int = 3) -> dict | None:
+    """Trace ``steps`` calls of ``step()`` and attribute device-op time.
+
+    Returns ``{"compute_ms", "collective_ms", "collective_pct"}`` with the
+    ms values per step summed across every device in the mesh (divide by
+    the device count for a per-chip figure), or ``None`` when the xplane
+    proto tooling is unavailable.
+    """
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: F401
+    except Exception:
+        return None
+    import jax
+
+    with tempfile.TemporaryDirectory() as d:
+        jax.profiler.start_trace(d)
+        try:
+            for _ in range(steps):
+                step()
+        finally:
+            jax.profiler.stop_trace()
+        files = glob.glob(d + "/**/*.xplane.pb", recursive=True)
+        if not files:
+            return None
+        compute_ms, collective_ms = map(sum, zip(*(map(_parse_xspace, files))))
+    compute_ms /= steps
+    collective_ms /= steps
+    total = compute_ms + collective_ms
+    return {
+        "compute_ms": compute_ms,
+        "collective_ms": collective_ms,
+        "collective_pct": 100.0 * collective_ms / total if total > 0 else 0.0,
+    }

@@ -54,5 +54,11 @@ def drain_generation(engine, tokenizer, detector: EosDetector, stream,
         if delta:
             content.append(delta)
             on_delta(delta)
-    engine.pos = min(engine.pos, prompt_end + n_completion)
+    # One position convention for every stop kind (ADVICE r01): the last
+    # consumed token — eos id, stop-string tail, or the final budgeted
+    # token — was sampled but never fed to the model, so the cache holds
+    # prompt + (n_completion − 1) positions.  The engine's internal eos-id
+    # rewind and the natural end-of-stream accounting already land there;
+    # this clamp brings the abandoned-mid-chunk (stop-string) case in line.
+    engine.pos = min(engine.pos, prompt_end + max(n_completion - 1, 0))
     return "".join(content), n_completion, ended_by_eos

@@ -24,7 +24,7 @@ import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, HTTPServer
 
-from ..runtime.engine import Engine
+from ..runtime.engine import ContextOverflow, Engine
 from ..runtime.stream import drain_generation
 from ..tokenizer.bpe import Tokenizer
 from ..tokenizer.chat import ChatItem, ChatTemplate, TokenizerChatStops
@@ -142,7 +142,7 @@ class ApiState:
         if prompt_end + 1 >= engine.seq_len:
             # refuse before touching the cache — a poisoned entry would make
             # every follow-up request resolve to a bogus start_pos
-            raise ValueError(
+            raise ContextOverflow(
                 f"prompt needs {prompt_end} of {engine.seq_len} context positions")
 
         for m in delta_messages:
@@ -229,10 +229,13 @@ def make_handler(state: ApiState):
 
                 try:
                     state.complete(params, emit)
-                except ValueError as e:
+                except ContextOverflow as e:
                     # headers already sent: emit an OpenAI-shaped error
                     # object and terminate WITHOUT a normal finish chunk, so
-                    # clients don't mistake the failure for an empty success
+                    # clients don't mistake the failure for an empty success.
+                    # Only the context-window refusal maps to a client error;
+                    # anything else is a server bug and propagates as a 500
+                    # (ADVICE r01: a bare ValueError catch masked bugs).
                     err = {"error": {"message": str(e),
                                      "type": "invalid_request_error"}}
                     self.wfile.write(f"data: {json.dumps(err)}\n\n".encode())
@@ -248,7 +251,7 @@ def make_handler(state: ApiState):
             else:
                 try:
                     reply, n_prompt, n_completion = state.complete(params, lambda d: None)
-                except ValueError as e:
+                except ContextOverflow as e:
                     self._json(400, {"error": str(e)})
                     return
                 self._json(200, {

@@ -1,0 +1,72 @@
+#!/usr/bin/env python3
+"""Minimal client for the dllama-api OpenAI-compatible server — the
+counterpart of the reference's `examples/chat-api-client.js`.
+
+Start the server first:
+    python -m dllama_tpu.server.api --model m.m --tokenizer t.t --port 9990
+
+Then:
+    python examples/chat-api-client.py [--port 9990] [--stream]
+"""
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9990)
+    ap.add_argument("--stream", action="store_true")
+    ap.add_argument("--max-tokens", type=int, default=64)
+    ap.add_argument("--system", default="You are an excellent math teacher.")
+    ap.add_argument("--user", default="What is 1 + 2?")
+    args = ap.parse_args()
+
+    body = {
+        "messages": [
+            {"role": "system", "content": args.system},
+            {"role": "user", "content": args.user},
+        ],
+        "temperature": 0.7,
+        "seed": 2096,
+        "max_tokens": args.max_tokens,
+        "stream": args.stream,
+    }
+    req = urllib.request.Request(
+        f"http://{args.host}:{args.port}/v1/chat/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+
+    try:
+        resp_cm = urllib.request.urlopen(req)
+    except urllib.error.HTTPError as e:
+        print(f"server returned {e.code}: {e.read().decode()}", file=sys.stderr)
+        return
+    with resp_cm as resp:
+        if not args.stream:
+            out = json.load(resp)
+            print(json.dumps(out, indent=2))
+            return
+        # SSE: one `data: {...}` chunk per delta, then `data: [DONE]`
+        for raw in resp:
+            line = raw.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            payload = line[len("data: "):]
+            if payload == "[DONE]":
+                break
+            chunk = json.loads(payload)
+            if "error" in chunk:
+                print(f"\nserver error: {chunk['error']['message']}", file=sys.stderr)
+                return
+            delta = chunk["choices"][0]["delta"].get("content", "")
+            sys.stdout.write(delta)
+            sys.stdout.flush()
+        print()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+#!/bin/sh
+# Full-KV-cache determinism smoke — the reference's examples/macbeth.sh
+# analogue: fill the entire context window at temperature 0 twice and
+# diff the outputs.  Point MODEL/TOKENIZER at any converted .m/.t pair.
+set -e
+MODEL="${MODEL:?set MODEL=/path/to/model.m}"
+TOKENIZER="${TOKENIZER:?set TOKENIZER=/path/to/tokenizer.t}"
+PROMPT="${PROMPT:-When shall we three meet again in thunder, lightning, or in rain?}"
+STEPS="${STEPS:-0}"   # 0 = run to a full context window
+
+run() {
+  python -m dllama_tpu generate --model "$MODEL" --tokenizer "$TOKENIZER" \
+    --prompt "$PROMPT" --steps "$STEPS" --temperature 0 --seed 1 \
+    --workers "${WORKERS:-tpu:1}"
+}
+
+A="$(run)"
+B="$(run)"
+[ "$A" = "$B" ] && echo "✅ deterministic over a full context window" \
+                || { echo "❌ outputs differ"; exit 1; }

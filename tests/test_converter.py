@@ -83,7 +83,6 @@ def test_convert_llama_meta_checkpoint(tmp_path):
     import torch
     import convert_llama
     dim, n_layers, n_heads, vocab = 64, 2, 4, 96
-    hidden = convert_llama.load_spec.__wrapped__ if False else None
     # Meta sizing rule: hidden = multiple_of * ceil((2*4*dim/3)/multiple_of)
     folder = tmp_path / "meta"
     folder.mkdir()

@@ -1,0 +1,119 @@
+"""Packed-Q40 MoE experts (VERDICT r01 #3).
+
+The reference keeps MoE expert weights Q40 end-to-end
+(transformer.cpp:299-317); round 1 dequantized every expert to dense f32 on
+host, making Mixtral-8x7B unloadable.  These tests cover the packed expert
+path: quantized-vs-dense numerics, the decode expert-select path, `.m`
+loading without f32 materialization, and N-shard ≡ 1-shard equivalence.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dllama_tpu import quants
+from dllama_tpu.io import mfile
+from dllama_tpu.models.config import tiny_config
+from dllama_tpu.models.params import init_params, load_params, quantize_matmuls
+from dllama_tpu.models.transformer import forward, init_kv_cache
+from dllama_tpu.ops import q40
+from dllama_tpu.parallel.mesh import make_mesh
+from dllama_tpu.runtime.engine import Engine
+from dllama_tpu.sampling import Sampler
+
+
+MOE_CFG = tiny_config(arch=mfile.ARCH_MIXTRAL, n_experts=4, n_active_experts=2,
+                      dim=64, hidden_dim=96, n_layers=2, n_heads=4,
+                      n_kv_heads=2, vocab_size=128, seq_len=64)
+
+
+def _dequant_all(params):
+    return {k: (q40.dequantize(v, jnp.float32) if isinstance(v, q40.QTensor) else v)
+            for k, v in params.items()}
+
+
+def test_quantize_matmuls_packs_experts():
+    qparams = quantize_matmuls(init_params(MOE_CFG, seed=0), MOE_CFG)
+    for k in ("up", "gate", "down"):
+        assert isinstance(qparams[k], q40.QTensor), k
+    assert qparams["up"].qpacked.shape == (2, 4, 32, 96)   # (L, E, n/2, F)
+    assert qparams["down"].qpacked.shape == (2, 4, 48, 64)  # (L, E, F/2, D)
+    assert isinstance(qparams["router"], jnp.ndarray)  # router stays dense
+
+
+def test_quantized_moe_prefill_matches_dense_dequant():
+    """Prefill (masked static expert loop) ≡ the dense einsum dispatch on
+    the same dequantized values."""
+    qparams = quantize_matmuls(init_params(MOE_CFG, seed=1), MOE_CFG)
+    dparams = _dequant_all(qparams)
+    tokens = jnp.asarray([[1, 9, 33, 7, 2]], jnp.int32)
+    cfg_q = MOE_CFG.with_(quant_impl="xla")
+    lq, _ = forward(qparams, cfg_q, tokens, init_kv_cache(MOE_CFG, 1), jnp.int32(0))
+    ld, _ = forward(dparams, MOE_CFG, tokens, init_kv_cache(MOE_CFG, 1), jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(lq), np.asarray(ld),
+                               rtol=0, atol=5e-2 + 2e-2 * np.abs(np.asarray(ld)).max())
+
+
+def test_quantized_moe_decode_matches_prefill():
+    """The decode path (per-token expert select on packed planes) must
+    agree with the prefill path (masked loop) — same model, positions fed
+    one at a time vs all at once."""
+    cfg = MOE_CFG.with_(quant_impl="xla")
+    qparams = quantize_matmuls(init_params(cfg, seed=2), cfg)
+    prompt = [3, 17, 29, 5]
+
+    e_pre = Engine(cfg, qparams)
+    l_pre, _ = e_pre.prefill(prompt)
+
+    e_dec = Engine(cfg, qparams)
+    for t in prompt[:-1]:
+        e_dec.decode_one(t)
+    l_dec, _ = e_dec.decode_one(prompt[-1])
+    np.testing.assert_allclose(l_pre, l_dec,
+                               rtol=0, atol=1e-3 + 1e-3 * np.abs(l_pre).max())
+
+
+def test_mixtral_q40_mfile_end_to_end(tmp_path):
+    """Q40 Mixtral .m → packed expert load (no dense f32) → generation."""
+    from tests.fixtures import write_tiny_model
+
+    path = tmp_path / "tiny-mixtral-q40.m"
+    write_tiny_model(str(path), arch=mfile.ARCH_MIXTRAL, ftype=quants.Q40,
+                     n_experts=4, vocab_size=64, seq_len=64)
+    mf = mfile.MFile(str(path))
+
+    cfg_q, qparams = load_params(mf, keep_quantized=True)
+    for k in ("up", "gate", "down"):
+        assert isinstance(qparams[k], q40.QTensor), k
+    assert qparams["up"].qpacked.dtype == jnp.uint8
+
+    cfg_d, dparams = load_params(mf, keep_quantized=False)
+    eq = Engine(cfg_q.with_(quant_impl="xla"), qparams)
+    ed = Engine(cfg_d, dparams)
+    lq, _ = eq.prefill([1, 5, 9])
+    ld, _ = ed.prefill([1, 5, 9])
+    np.testing.assert_allclose(lq, ld, rtol=0, atol=5e-2 + 2e-2 * np.abs(ld).max())
+
+    # generation runs on the packed path without error
+    toks = [t for t, _ in eq.generate([1, 5, 9], steps=8,
+                                      sampler=Sampler(cfg_q.vocab_size, 0.0, 0.9, 0))]
+    assert len(toks) == 8
+
+
+def test_tp8_quantized_moe_matches_tp1():
+    """N-shard ≡ 1-shard with packed experts on the pallas-interpret
+    shard_map path (shard-clean shapes)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    cfg = tiny_config(arch=mfile.ARCH_MIXTRAL, n_experts=4, n_active_experts=2,
+                      dim=256, hidden_dim=256, n_layers=2, n_heads=8,
+                      n_kv_heads=8, vocab_size=128, seq_len=32,
+                      ).with_(quant_impl="pallas_interpret")
+    qparams = quantize_matmuls(init_params(cfg, seed=3), cfg)
+    prompt = [1, 2, 3]
+    e1 = Engine(cfg, qparams, mesh=make_mesh(tp=1, devices=jax.devices()[:1]))
+    e8 = Engine(cfg, qparams, mesh=make_mesh(tp=8))
+    l1, _ = e1.prefill(prompt)
+    l8, _ = e8.prefill(prompt)
+    np.testing.assert_allclose(l1, l8, rtol=0, atol=1e-3 + 1e-3 * np.abs(l1).max())
