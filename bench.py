@@ -234,7 +234,9 @@ def main():
     def remaining():
         return BUDGET_S - (time.time() - t_start)
 
-    cpu_env = {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"}
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from dllama_tpu.hostenv import forced_cpu_env
+    cpu_env = forced_cpu_env(1)
 
     probe = _spawn("probe", min(PROBE_TIMEOUT_S, max(remaining() - 420, 60)))
     on_hw = probe is not None and probe.get("platform") != "cpu"

@@ -1,0 +1,32 @@
+"""Subprocess environment helpers for backend selection.
+
+The session image pins JAX to the axon TPU backend at interpreter start
+(sitecustomize registers it whenever ``PALLAS_AXON_POOL_IPS`` is set), and a
+backend cannot be re-selected in-process once initialized.  Anything that
+needs a CPU mesh from a TPU-pinned parent — the multichip dryrun, the CLI
+tests, the bench CPU fallback — must therefore spawn a child process whose
+environment forces CPU *before* JAX loads.  This is the one shared copy of
+that recipe.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def forced_cpu_env(n_devices: int = 1, base: dict | None = None) -> dict:
+    """Environment that selects the CPU backend with ``n_devices`` virtual
+    XLA devices, regardless of what the parent process's backend is.
+
+    Any pre-existing ``--xla_force_host_platform_device_count`` flag is
+    replaced (not merely appended to) so a stale count of 1 cannot shadow
+    the requested mesh size.
+    """
+    env = dict(os.environ if base is None else base)
+    env["PALLAS_AXON_POOL_IPS"] = ""   # axon sitecustomize gates on this
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env

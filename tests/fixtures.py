@@ -56,14 +56,11 @@ def write_tiny_tokenizer(path, vocab_size=300) -> tfile.TokenizerData:
 
 
 def cpu_env(n_devices: int = 1) -> dict:
-    """Subprocess env that actually selects the CPU backend: the axon
-    sitecustomize only registers the TPU when PALLAS_AXON_POOL_IPS is set,
-    so blanking it lets JAX_PLATFORMS=cpu take effect."""
-    env = dict(os.environ)
-    env["PALLAS_AXON_POOL_IPS"] = ""
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
-                        f" --xla_force_host_platform_device_count={n_devices}").strip()
+    """Subprocess env that actually selects the CPU backend (shared recipe,
+    see dllama_tpu/hostenv.py)."""
+    from dllama_tpu.hostenv import forced_cpu_env
+
+    env = forced_cpu_env(n_devices)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     return env
 
