@@ -90,7 +90,7 @@ def _zero_q40_params(cfg):
             np_ = padded_n(n)
             params[k] = QTensor(
                 jnp.zeros((*lead, np_ // 2, d), jnp.uint8),
-                jnp.zeros((*lead, np_ // 32, d), jnp.float32), (n, d))
+                jnp.zeros((*lead, np_ // 32, d), jnp.float16), (n, d))
         else:
             params[k] = jnp.zeros(shape, jnp.float32 if k.startswith("rms") else cfg.dtype)
     return params

@@ -140,7 +140,7 @@ def _stack_q_experts(mf: mfile.MFile, cfg: ModelConfig, fname: str) -> q40.QTens
         *(np.swapaxes(p, -1, -2) for p in mf.q40_planes(f"layers.0.experts.0.{fname}")))
     qp0, sc0, nd = first
     qp = np.empty((L, E) + qp0.shape, np.uint8)
-    sc = np.empty((L, E) + sc0.shape, np.float32)
+    sc = np.empty((L, E) + sc0.shape, np.float16)
     for l in range(L):
         for e in range(E):
             if l == 0 and e == 0:

@@ -18,9 +18,10 @@ block):
   nibble and logical row ``32b + 16 + r`` in its high nibble, biased +8.
   (The reference's own BlockQ40 uses the same lo/hi split within a block,
   quants.hpp:17-20.)
-* ``scales`` f32 ``(..., N/32, D)`` — the per-block f16 deltas from the
-  `.m` file, widened to f32 (f16 compute is awkward on TPU; f32 scales
-  cost 0.125 B/weight).
+* ``scales`` f16 ``(..., N/32, D)`` — the per-block f16 deltas exactly as
+  the `.m` file stores them (quants.hpp:17-20), 0.0625 B/weight; widened
+  on the fly (f16→f32 is exact, so dequantization is bit-identical to the
+  reference codec).
 
 Two matmul implementations:
 
@@ -49,6 +50,7 @@ fed without a quantize/dequantize round trip).
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass, field
 
 import jax
@@ -68,6 +70,8 @@ TILE_D = 1024
 # Decode uses the Pallas kernel; past this many rows the matmul is MXU-bound
 # and the XLA path (which can pipeline the dequant) is preferable.
 PALLAS_MAX_ROWS = 128
+# Kernel dequant variant (see _q40_kernel): classic | folded | exact.
+KERNEL_VARIANT = os.environ.get("DLLAMA_Q40_VARIANT", "classic")
 
 
 def padded_n(n: int) -> int:
@@ -92,7 +96,7 @@ class QTensor:
     Storage rows cover ``padded_n(n)`` input positions (see above)."""
 
     qpacked: jax.Array          # uint8 (..., padded_n/2, d)
-    scales: jax.Array           # f32   (..., padded_n/32, d)
+    scales: jax.Array           # f16   (..., padded_n/32, d)
     logical_nd: tuple[int, int] = field(metadata=dict(static=True))
 
     @property
@@ -109,7 +113,7 @@ def pack_planes_np(qvals: np.ndarray, scales: np.ndarray
     """Pack int8 nibble values ``(..., n, d)`` in [-8, 7] + scales
     ``(..., n/32, d)`` into the block-local layout as **host numpy arrays**
     (padding the input dim to ``padded_n``; padded scales are zero).
-    Returns ``(packed u8, scales f32, logical_nd)`` — the loader uses this
+    Returns ``(packed u8, scales f16, logical_nd)`` — the loader uses this
     to fill preallocated stacks without device round trips."""
     *lead, n, d = qvals.shape
     np_ = padded_n(n)
@@ -122,7 +126,7 @@ def pack_planes_np(qvals: np.ndarray, scales: np.ndarray
             [packed, np.zeros((*lead, (np_ - n) // 2, d), np.uint8)], axis=-2)
         scales = np.concatenate(
             [scales, np.zeros((*lead, (np_ - n) // 32, d), scales.dtype)], axis=-2)
-    return packed, scales.astype(np.float32), (n, d)
+    return packed, scales.astype(np.float16), (n, d)
 
 
 def pack_planes(qvals: np.ndarray, scales: np.ndarray) -> QTensor:
@@ -147,8 +151,7 @@ def quantize(w: np.ndarray) -> QTensor:
     # f32 delta, stored scale rounded to the file's f16 precision
     inv = np.where(deltas != 0, np.divide(1.0, deltas, where=deltas != 0), 0.0)
     q = np.clip(g * inv[..., None, :] + 8.5, 0.0, 15.0).astype(np.uint8).astype(np.int8) - 8
-    return pack_planes(q.reshape(*lead, n, d),
-                       deltas.astype(np.float16).astype(np.float32))
+    return pack_planes(q.reshape(*lead, n, d), deltas.astype(np.float16))
 
 
 def pack_planes_t(qvals: np.ndarray, scales: np.ndarray) -> QTensor:
@@ -203,20 +206,71 @@ def dequantize(qt: QTensor, dtype=jnp.float32) -> jax.Array:
 # Pallas fused kernel
 # ---------------------------------------------------------------------------
 
-def _q40_kernel(x_ref, qp_ref, s_ref, o_ref, acc_ref, *, nsteps):
+def _q40_kernel(xlo_ref, xhi_ref, xs_ref, qp_ref, s_ref, o_ref, acc_ref, *,
+                nsteps, variant):
+    """One (tile_n × tile_d) fused dequant-matmul step.
+
+    The lo/hi nibble planes are contracted by two separate dots against the
+    matching halves of x (prepared outside the kernel, where XLA fuses the
+    splits), which avoids a concat-to-logical-order relayout.  VPU unpack
+    work is the decode bottleneck after DMA, so three ``variant`` trade-offs
+    exist between per-weight VPU ops and rounding:
+
+    * ``classic`` — ``bf16(f32(v−8)·s)`` per weight: the reference's
+      dequantization rounding (one bf16 round of the exact product,
+      funcs.cpp:330-335 semantics); ~5.5 VPU ops/weight.
+    * ``folded``  — the −8 bias never touches the weights: with
+      ``w=(v−8)·s``, ``x·w = x·(v·s) − 8·(Σ_block x)·s``, so the kernel
+      feeds the MXU ``bf16(v)·bf16(s)`` and corrects with a per-block dot
+      against precomputed block sums of x; ~3.5 VPU ops/weight, rounding
+      ~2× classic (still an order below the codec's ±s/2).
+    * ``exact``   — per-block batched dots of the *raw* nibbles (integers
+      ≤15, exact in bf16), scales applied per (block, column) in f32
+      afterwards; ~2.5 VPU ops/weight and *less* rounding than classic —
+      but its (nb, t, 16)×(nb, 16, td) batched dots stress the MXU with
+      K=16 passes, so its win is hardware-dependent.
+    """
     i = pl.program_id(1)
     qp = qp_ref[...]                                      # (tn/2, td) uint8
     tn2, td = qp.shape[-2:]
     qp = qp.reshape(tn2, td)
-    s = s_ref[...].reshape(tn2 // 16, td)
     nb = tn2 // 16
-    # Mosaic has no int8 vector sub / u8→f convert; widen to i32 first.
-    v = qp.reshape(nb, 16, td).astype(jnp.int32)
-    lo = (v & 0xF).astype(jnp.float32)
-    hi = (v >> 4).astype(jnp.float32)
-    w = jnp.concatenate([lo, hi], axis=1) - 8.0           # (nb, 32, td)
-    w = (w * s[:, None, :]).astype(jnp.bfloat16).reshape(nb * 32, td)
-    part = jnp.dot(x_ref[:], w, preferred_element_type=jnp.float32)
+    s = s_ref[...].reshape(nb, td)                        # f16
+    vi = qp.astype(jnp.int32)
+
+    if variant == "exact":
+        lo = (vi & 0xF).astype(jnp.bfloat16).reshape(nb, 16, td)
+        hi = (vi >> 4).astype(jnp.bfloat16).reshape(nb, 16, td)
+        xlo = xlo_ref[:]                                  # (t, tn/2) bf16
+        t = xlo.shape[0]
+        xlo = xlo.reshape(t, nb, 16).swapaxes(0, 1)       # (nb, t, 16)
+        xhi = xhi_ref[:].reshape(t, nb, 16).swapaxes(0, 1)
+        dot = functools.partial(
+            jax.lax.dot_general,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        p = dot(xlo, lo) + dot(xhi, hi)                   # (nb, t, td)
+        s32 = s.astype(jnp.float32)
+        corr = p - 8.0 * xs_ref[:].astype(jnp.float32).swapaxes(0, 1)[:, :, None]
+        part = jnp.sum(corr * s32[:, None, :], axis=0)    # (t, td)
+    else:
+        if variant == "classic":
+            s32 = s.astype(jnp.float32)
+            lo = ((vi & 0xF).astype(jnp.float32) - 8.0).reshape(nb, 16, td)
+            hi = ((vi >> 4).astype(jnp.float32) - 8.0).reshape(nb, 16, td)
+            lo = (lo * s32[:, None, :]).astype(jnp.bfloat16).reshape(tn2, td)
+            hi = (hi * s32[:, None, :]).astype(jnp.bfloat16).reshape(tn2, td)
+            bias = 0.0
+        else:  # folded
+            sb = s.astype(jnp.bfloat16)
+            lo = (vi & 0xF).astype(jnp.bfloat16).reshape(nb, 16, td)
+            hi = (vi >> 4).astype(jnp.bfloat16).reshape(nb, 16, td)
+            lo = (lo * sb[:, None, :]).reshape(tn2, td)
+            hi = (hi * sb[:, None, :]).reshape(tn2, td)
+            bias = 8.0 * jnp.dot(xs_ref[:], sb, preferred_element_type=jnp.float32)
+        part = (jnp.dot(xlo_ref[:], lo, preferred_element_type=jnp.float32)
+                + jnp.dot(xhi_ref[:], hi, preferred_element_type=jnp.float32)
+                - bias)
 
     @pl.when(i == 0)
     def _():
@@ -231,9 +285,33 @@ def _q40_kernel(x_ref, qp_ref, s_ref, o_ref, acc_ref, *, nsteps):
         o_ref[:] = acc_ref[:]
 
 
-def _stacked_q40_kernel(lidx_ref, x_ref, qp_ref, s_ref, o_ref, acc_ref, *, nsteps):
+def _stacked_q40_kernel(lidx_ref, xlo_ref, xhi_ref, xs_ref, qp_ref, s_ref,
+                        o_ref, acc_ref, *, nsteps, variant):
     del lidx_ref  # consumed by the index_maps
-    _q40_kernel(x_ref, qp_ref, s_ref, o_ref, acc_ref, nsteps=nsteps)
+    _q40_kernel(xlo_ref, xhi_ref, xs_ref, qp_ref, s_ref, o_ref, acc_ref,
+                nsteps=nsteps, variant=variant)
+
+
+def _x_parts(x: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Split activations (t, n) into the packed-row-order halves and block
+    sums the kernel contracts against: ``x_lo``/``x_hi`` (t, n/2) matching
+    the low/high nibble planes, ``xs`` (t, n/32) per-block sums for the −8
+    bias correction."""
+    t, n = x.shape
+    nb = n // 32
+    xr = x.reshape(t, nb, 32)
+    x_lo = xr[:, :, :16].reshape(t, n // 2)
+    x_hi = xr[:, :, 16:].reshape(t, n // 2)
+    xs = xr.astype(jnp.float32).sum(axis=-1).astype(jnp.bfloat16)
+    return x_lo, x_hi, xs
+
+
+def _check_variant(variant: str | None) -> str:
+    v = variant or KERNEL_VARIANT
+    if v not in ("classic", "folded", "exact"):
+        raise ValueError(f"unknown q40 kernel variant {v!r} "
+                         "(expected classic | folded | exact)")
+    return v
 
 
 def _tiles(n: int, d: int) -> tuple[int, int]:
@@ -251,32 +329,39 @@ def _tiles(n: int, d: int) -> tuple[int, int]:
     return tile_n, tile_d
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "variant"))
 def _pallas_matmul(x: jax.Array, qpacked: jax.Array, scales: jax.Array,
-                   interpret: bool = False) -> jax.Array:
+                   interpret: bool = False, variant: str | None = None) -> jax.Array:
     """x (t, n_padded) @ packed (n_padded/2, d) → (t, d) f32."""
     t, n = x.shape
     d = qpacked.shape[-1]
     tile_n, tile_d = _tiles(n, d)
     grid = (pl.cdiv(d, tile_d), n // tile_n)
+    x_lo, x_hi, xs = _x_parts(x.astype(jnp.bfloat16))
     return pl.pallas_call(
-        functools.partial(_q40_kernel, nsteps=grid[1]),
+        functools.partial(_q40_kernel, nsteps=grid[1],
+                          variant=_check_variant(variant)),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((t, tile_n), lambda j, i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, tile_n // 2), lambda j, i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, tile_n // 2), lambda j, i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, tile_n // 32), lambda j, i: (0, i), memory_space=pltpu.VMEM),
             pl.BlockSpec((tile_n // 2, tile_d), lambda j, i: (i, j), memory_space=pltpu.VMEM),
             pl.BlockSpec((tile_n // 32, tile_d), lambda j, i: (i, j), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((t, tile_d), lambda j, i: (0, j), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
         scratch_shapes=[pltpu.VMEM((t, tile_d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(x.astype(jnp.bfloat16), qpacked, scales)
+    )(x_lo, x_hi, xs, qpacked, scales)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "variant"))
 def _pallas_matmul_stacked(x: jax.Array, qpacked: jax.Array, scales: jax.Array,
-                           layer: jax.Array, interpret: bool = False) -> jax.Array:
+                           layer: jax.Array, interpret: bool = False,
+                           variant: str | None = None) -> jax.Array:
     """Layer-indexed matmul over layer-stacked packed weights.
 
     The layer index rides as a scalar-prefetch argument into the block
@@ -290,13 +375,17 @@ def _pallas_matmul_stacked(x: jax.Array, qpacked: jax.Array, scales: jax.Array,
     d = qpacked.shape[-1]
     tile_n, tile_d = _tiles(n, d)
     grid = (pl.cdiv(d, tile_d), n // tile_n)
+    x_lo, x_hi, xs = _x_parts(x.astype(jnp.bfloat16))
     out = pl.pallas_call(
-        functools.partial(_stacked_q40_kernel, nsteps=grid[1]),
+        functools.partial(_stacked_q40_kernel, nsteps=grid[1],
+                          variant=_check_variant(variant)),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((t, tile_n), lambda j, i, l: (0, i)),
+                pl.BlockSpec((t, tile_n // 2), lambda j, i, l: (0, i)),
+                pl.BlockSpec((t, tile_n // 2), lambda j, i, l: (0, i)),
+                pl.BlockSpec((t, tile_n // 32), lambda j, i, l: (0, i)),
                 pl.BlockSpec((1, tile_n // 2, tile_d), lambda j, i, l: (l[0], i, j)),
                 pl.BlockSpec((1, tile_n // 32, tile_d), lambda j, i, l: (l[0], i, j)),
             ],
@@ -304,8 +393,10 @@ def _pallas_matmul_stacked(x: jax.Array, qpacked: jax.Array, scales: jax.Array,
             scratch_shapes=[pltpu.VMEM((t, tile_d), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(layer.reshape(1).astype(jnp.int32), x.astype(jnp.bfloat16), qpacked, scales)
+    )(layer.reshape(1).astype(jnp.int32), x_lo, x_hi, xs, qpacked, scales)
     return out
 
 
