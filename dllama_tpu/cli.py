@@ -10,11 +10,16 @@ and the same four modes (dllama.cpp:221-252):
 * ``chat``      — REPL with system prompt, chat template, streaming EOS
   detection, KV position persisting across turns (dllama.cpp:111-203).
 * ``worker``    — in the reference, a TCP worker process (dllama.cpp:205-
-  219).  On TPU the "workers" are mesh devices inside one process, so this
-  mode only explains the mapping and exits.
+  219).  Within one host the "workers" are mesh devices inside one
+  process; across hosts, ``worker`` joins the multi-host process group
+  (``--coordinator host:port --nproc N --proc-id K``, parallel/
+  distributed.py) and runs the same SPMD program as the root with stdout
+  suppressed.
 
 ``--workers`` keeps its name but takes ``tpu:N`` (a mesh degree) instead of
-host:port pairs — the transport is XLA collectives, not sockets.
+host:port pairs — the transport is XLA collectives, not sockets.  ``--sp``/
+``--dp`` add sequence-parallel (long context) and data-parallel (batch)
+mesh axes, capability the reference does not have.
 """
 
 from __future__ import annotations
@@ -59,6 +64,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "tasks.cpp:124-163 — the 'wire' here is ICI inside the "
                         "XLA program)")
     p.add_argument("--workers", default=None, help="tpu:N mesh degree")
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel degree: shards the KV cache's "
+                        "sequence axis over the mesh for long context "
+                        "(beyond-reference capability; see ops/sp_attention.py)")
+    p.add_argument("--dp", type=int, default=1,
+                   help="data-parallel degree: batches dp identical streams "
+                        "over a dp mesh axis (beyond-reference capability; "
+                        "only stream 0 is printed)")
+    p.add_argument("--coordinator", default=None,
+                   help="multi-host: process-0 host:port for "
+                        "jax.distributed.initialize (parallel/distributed.py); "
+                        "every process runs the same command with the same "
+                        "model flags")
+    p.add_argument("--nproc", type=int, default=None,
+                   help="multi-host: total process count")
+    p.add_argument("--proc-id", type=int, default=None,
+                   help="multi-host: this process's id (0 = root)")
+    p.add_argument("--program", choices=["generate", "inference"], default="generate",
+                   help="worker mode: which root program this worker mirrors "
+                        "(multi-host SPMD runs the same program on every process)")
     p.add_argument("--max-seq-len", type=int, default=None)
     p.add_argument("--kv-cache-dtype", choices=list(DTYPES), default=None)
     p.add_argument("--chunk", type=int, default=16, help="on-device decode chunk size")
@@ -92,15 +117,17 @@ def load_stack(args) -> tuple[Engine, Tokenizer]:
     print(f"💡 arch: {mf.spec.arch_name}")
     print(f"💡 dim: {cfg.dim}\n💡 nLayers: {cfg.n_layers}\n💡 nHeads: {cfg.n_heads}")
     print(f"💡 nKvHeads: {cfg.n_kv_heads}\n💡 vocabSize: {cfg.vocab_size}\n💡 seqLen: {cfg.seq_len}")
-    mesh = parse_workers(args.workers)
-    print(f"💡 mesh: tp={mesh.shape['tp']}")
+    mesh = parse_workers(args.workers, sp=args.sp, dp=args.dp)
+    axes = {k: v for k, v in mesh.shape.items() if v > 1} or {"tp": 1}
+    print("💡 mesh: " + " ".join(f"{k}={v}" for k, v in axes.items()))
     # fused qkv/w13 is the single-chip fast layout; under tp>1 the unfused
     # per-tensor layout shards cleanly (see load_params)
     cfg, params = load_params(mf, cfg, dtype=dtype,
                               keep_quantized=not args.dequantize,
                               fuse=mesh.shape.get("tp", 1) == 1)
     kv_dtype = jnp.dtype(DTYPES[args.kv_cache_dtype]) if args.kv_cache_dtype else None
-    engine = Engine(cfg, params, mesh=mesh, seq_len=args.max_seq_len, kv_dtype=kv_dtype)
+    engine = Engine(cfg, params, mesh=mesh, seq_len=args.max_seq_len,
+                    kv_dtype=kv_dtype, batch=max(args.dp, 1))
     tok = Tokenizer(tfile.read_tfile(args.tokenizer))
     if tok.vocab_size != cfg.vocab_size:
         raise SystemExit("tokenizer is incompatible with model (vocab size mismatch)")
@@ -117,6 +144,9 @@ def cmd_inference(args) -> None:
     prompt = args.prompt or "Hello world"
     ids = tok.encode(prompt, add_bos=True)
     steps = args.steps or 64
+    if args.chunk > 1:
+        print(f"💡 decode runs on-device in chunks of {args.chunk}; G/I/T "
+              "lines within a chunk are that chunk's per-token averages")
     stats = RunStats()
     pieces = []
     prev = tok.bos_id
@@ -220,14 +250,42 @@ def cmd_chat(args) -> None:
 
 
 def cmd_worker(args) -> None:
-    print("On this framework the reference's worker processes are TPU mesh devices\n"
-          "inside one program: run the root command with --workers tpu:N instead.\n"
-          "(reference: dllama.cpp:205-219 TCP worker; here the transport is XLA\n"
-          "collectives over ICI — see dllama_tpu/parallel/)")
+    """Join a multi-host run as one SPMD process (reference: the TCP worker
+    that executes the same task list as root, dllama.cpp:205-219 +
+    Worker::work tasks.cpp:230-256).
+
+    Requires process coordinates (--coordinator/--nproc/--proc-id or the
+    DLLAMA_* env vars) and the same model flags as the root: every process
+    executes the same XLA programs; only process 0 owns stdout.  Within a
+    single host no worker processes exist at all — the mesh devices are the
+    workers — so without coordinates this mode just explains the mapping.
+    """
+    from .parallel.distributed import distributed_env
+
+    if not args.coordinator and distributed_env() is None:
+        print("On this framework the reference's worker processes are TPU mesh devices\n"
+              "inside one program: run the root command with --workers tpu:N instead.\n"
+              "For MULTI-HOST runs (e.g. a v5e-16/32 pod slice), start this mode on\n"
+              "every host with --coordinator host:port --nproc N --proc-id K and the\n"
+              "same --model/--tokenizer/--prompt flags; process 0 prints, the rest\n"
+              "compute. (reference: dllama.cpp:205-219 TCP worker; transport here is\n"
+              "XLA collectives over ICI/DCN — see dllama_tpu/parallel/distributed.py)")
+        return
+    # init happened in main(); suppress stdout on non-root processes and run
+    # the mirrored program
+    from .parallel.distributed import is_output_process
+
+    if not is_output_process():
+        import os
+        sys.stdout = open(os.devnull, "w")
+    {"inference": cmd_inference, "generate": cmd_generate}[args.program](args)
 
 
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
+    from .parallel.distributed import distributed_env, init_distributed
+    if args.coordinator or distributed_env() is not None:
+        init_distributed(args.coordinator, args.nproc, args.proc_id)
     {"inference": cmd_inference, "generate": cmd_generate,
      "chat": cmd_chat, "worker": cmd_worker}[args.mode](args)
 

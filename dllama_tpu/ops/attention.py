@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .kernels import softmax_f32
 
@@ -40,7 +41,9 @@ def update_kv_cache(k_cache: jax.Array, v_cache: jax.Array,
 # (B, Hkv, G, T, S) f32 score tensor, which becomes the HBM wall at long
 # context (VERDICT r01 weak #5).
 _BLOCKED_THRESHOLD = 1 << 21
-_NEG = jnp.float32(-1e30)  # finite -inf stand-in: keeps the running max
+# numpy (not jnp): a module-level device constant would initialize the XLA
+# backend at import time, breaking jax.distributed.initialize ordering
+_NEG = np.float32(-1e30)  # finite -inf stand-in: keeps the running max
 
 
 def _kv_chunk(s: int) -> int:

@@ -1,0 +1,74 @@
+"""Multi-host (multi-process) mesh membership.
+
+The reference scales beyond one box by adding TCP worker processes
+(`dllama worker --port …`, dllama.cpp:205-219, served by SocketServer,
+socket.cpp:355-397).  The TPU-native equivalent is JAX process groups:
+every host runs the *same* SPMD program, `jax.distributed.initialize`
+wires the processes into one runtime, and `jax.devices()` then spans all
+hosts — a v5e-16/32 pod slice shows up as one mesh, and the existing
+`--workers tpu:N` sharding covers it with XLA collectives riding
+ICI/DCN instead of the reference's TCP star.
+
+Operational contract (mirrors the reference's "start workers first, then
+root", socket.cpp:174-178): every process — the root is simply process 0 —
+runs the same CLI command with the same model/tokenizer/prompt flags plus
+its process coordinates (``--coordinator host:port --nproc N --proc-id K``
+or the DLLAMA_COORDINATOR / DLLAMA_NPROC / DLLAMA_PROC_ID environment
+variables).  Process 0's host:port is the coordination service; non-zero
+processes print nothing (the reference's workers likewise own no stdout
+contract — only root prints, transformer.cpp:213-224).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def distributed_env() -> tuple[str, int, int] | None:
+    """Read process coordinates from the environment, or ``None``."""
+    coord = os.environ.get("DLLAMA_COORDINATOR")
+    if not coord:
+        return None
+    return (coord,
+            int(os.environ.get("DLLAMA_NPROC", "1")),
+            int(os.environ.get("DLLAMA_PROC_ID", "0")))
+
+
+def init_distributed(coordinator: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> int:
+    """Join (or create, as process 0) the multi-host process group.
+
+    Arguments fall back to the DLLAMA_* environment variables.  Returns the
+    process id.  Must run before the first device query in the process —
+    the same constraint the backend pinning imposes everywhere else
+    (hostenv.py).
+    """
+    env = distributed_env()
+    if coordinator is None and env is not None:
+        coordinator, num_processes, process_id = env
+    if coordinator is None:
+        raise ValueError(
+            "multi-host init needs --coordinator host:port (+ --nproc/--proc-id) "
+            "or DLLAMA_COORDINATOR/DLLAMA_NPROC/DLLAMA_PROC_ID")
+    if (num_processes or 1) > 1 and process_id is None:
+        # defaulting to 0 would register every such host as the root and
+        # deadlock the coordinator waiting for the missing ids
+        raise ValueError("--proc-id is required when --nproc > 1")
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes if num_processes is not None else 1,
+        process_id=process_id if process_id is not None else 0)
+    return jax.process_index()
+
+
+def is_output_process() -> bool:
+    """True when this process owns stdout (process 0, or single-process)."""
+    import jax
+
+    try:
+        return jax.process_index() == 0
+    except Exception:
+        return True

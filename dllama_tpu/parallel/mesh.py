@@ -54,6 +54,9 @@ def make_mesh(tp: int | None = None, sp: int = 1, dp: int = 1,
     devices = list(devices if devices is not None else jax.devices())
     if tp is None:
         tp = len(devices) // (sp * dp)
+        if tp == 0:
+            raise ValueError(
+                f"mesh sp={sp}×dp={dp} already exceeds {len(devices)} devices")
     n = dp * sp * tp
     if n > len(devices):
         raise ValueError(f"mesh {dp}x{sp}x{tp} needs {n} devices, have {len(devices)}")
@@ -61,20 +64,25 @@ def make_mesh(tp: int | None = None, sp: int = 1, dp: int = 1,
     return Mesh(arr, axis_names=("dp", "sp", "tp"))
 
 
-def parse_workers(workers: str | None, devices=None) -> Mesh:
-    """Parse the CLI ``--workers`` value into a mesh.
+def parse_workers(workers: str | None, sp: int = 1, dp: int = 1,
+                  devices=None) -> Mesh:
+    """Parse the CLI ``--workers`` value (+ ``--sp``/``--dp`` degrees) into
+    a mesh.
 
     ``tpu:N`` → N-way tensor parallel (the BASELINE.json north-star form);
-    ``None``/"" → all local devices, pure TP.
+    ``None``/"" → all remaining devices go to tp.  ``sp``/``dp`` add
+    sequence-parallel (long context) and data-parallel (batch) axes —
+    capability beyond the reference, whose only option is TP
+    (README.md:7); the total dp·sp·tp must fit the device count.
     Host:port worker lists are the reference's CPU-cluster transport and are
     intentionally not supported — the transport here is XLA collectives.
     """
     devices = list(devices if devices is not None else jax.devices())
     if not workers:
-        return make_mesh(devices=devices)
+        return make_mesh(sp=sp, dp=dp, devices=devices)
     if workers.startswith("tpu:"):
         n = int(workers.split(":", 1)[1])
-        return make_mesh(tp=n, devices=devices)
+        return make_mesh(tp=n, sp=sp, dp=dp, devices=devices)
     raise ValueError(
         f"unsupported --workers value {workers!r}: this framework replaces the "
         "TCP star with a TPU mesh; use 'tpu:N'")

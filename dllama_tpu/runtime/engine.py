@@ -85,8 +85,8 @@ class StepStats:
     generation_ms: float = 0.0  # G: total wall time for the token
     inference_ms: float = 0.0   # I: device execution
     transfer_ms: float = 0.0    # T: host<->device boundary
-    sent_bytes: int = 0         # S: host → device
-    recv_bytes: int = 0         # R: device → host
+    sent_bytes: float = 0.0     # S: host → device (fractional per token when
+    recv_bytes: float = 0.0     # R: device → host   averaged over a chunk)
 
 
 @dataclass
@@ -281,12 +281,14 @@ class Engine:
             toks = np.asarray(toks_dev)[:, 0]  # (k,)
             t2 = time.perf_counter()
             self.pos = p0 + k
+            # chunk averages: each of the k tokens carries 1/k of the
+            # chunk's wall/device/boundary cost (labeled as such in the CLI)
             per = StepStats(
                 generation_ms=(t2 - t0) * 1000 / k,
                 inference_ms=(t1 - t0) * 1000 / k,
                 transfer_ms=(t2 - t1) * 1000 / k,
-                sent_bytes=(self.batch * 4 + 8) // k,
-                recv_bytes=toks.nbytes // k)
+                sent_bytes=(self.batch * 4 + 8) / k,
+                recv_bytes=toks.nbytes / k)
             for j, tk in enumerate(toks.tolist()):
                 token = int(tk)
                 yield token, per

@@ -82,3 +82,38 @@ def test_tp4_workers_flag(model_files):
                   "--steps", "6", "--workers", "tpu:4"], n_devices=4)
     assert r4.returncode != 0
     assert "nKvHeads" in r4.stderr
+
+
+def test_sp_flag_runs_sequence_parallel(model_files):
+    """Long context is operator-reachable: --sp 2 builds a tp×sp mesh from
+    the CLI and inference still produces stats (VERDICT r02 Missing #4)."""
+    m, t = model_files
+    r = run_cli(["inference", "--model", m, "--tokenizer", t, "--prompt", "hello",
+                 "--steps", "6", "--temperature", "0", "--workers", "tpu:2",
+                 "--sp", "2", "--max-seq-len", "64"], n_devices=4)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "sp=2" in r.stdout and "tp=2" in r.stdout
+    assert "Avg tokens / second:" in r.stdout
+
+
+def test_dp_flag_runs_batched(model_files):
+    m, t = model_files
+    r = run_cli(["generate", "--model", m, "--tokenizer", t, "--prompt", "hello",
+                 "--steps", "6", "--temperature", "0", "--workers", "tpu:2",
+                 "--dp", "2"], n_devices=4)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "dp=2" in r.stdout
+
+
+def test_worker_joins_single_process_group(model_files):
+    """Multi-host wiring end-to-end at nproc=1: worker mode initializes the
+    JAX process group via the coordinator and runs the mirrored program
+    (reference contract: worker executes the same task list as root,
+    tasks.cpp:230-256)."""
+    m, t = model_files
+    r = run_cli(["worker", "--coordinator", "127.0.0.1:39171", "--nproc", "1",
+                 "--proc-id", "0", "--program", "generate", "--model", m,
+                 "--tokenizer", t, "--prompt", "hello", "--steps", "6",
+                 "--temperature", "0"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert len(r.stdout.strip()) > 0
