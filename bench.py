@@ -96,6 +96,83 @@ def _zero_q40_params(cfg):
     return params
 
 
+def _synth_model_files(name, dirpath):
+    """Synthesize a full-size Q40 `.m` (+ matching `.t`) at packed size —
+    random nibble blocks with a constant small f16 scale, written via
+    MFileWriter.write_raw with no f32 transit (VERDICT r02 Next #3: bench
+    the operator surface, loader included, not a zero-buffer bypass)."""
+    import numpy as np
+    from dllama_tpu import quants
+    from dllama_tpu.io import mfile
+    from tests.fixtures import write_tiny_tokenizer
+
+    cfg = _model_cfg(name)
+    spec = mfile.ModelSpec(
+        arch=mfile.ARCH_LLAMA, dim=cfg.dim, hidden_dim=cfg.hidden_dim,
+        n_layers=cfg.n_layers, n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads,
+        n_experts=0, n_active_experts=0, vocab_size=cfg.vocab_size,
+        seq_len=cfg.seq_len, hidden_act=mfile.ACT_SILU, rope_theta=10000.0,
+        weights_ftype=quants.Q40)
+    mpath = os.path.join(dirpath, f"{name}-synth.m")
+    tpath = os.path.join(dirpath, f"{name}-synth.t")
+    if not os.path.exists(tpath):
+        write_tiny_tokenizer(tpath, vocab_size=cfg.vocab_size)
+    if os.path.exists(mpath):
+        return mpath, tpath
+    rng = np.random.RandomState(0)
+    scale = np.frombuffer(np.float16(0.008).tobytes(), np.uint8)
+    nib_pool = rng.randint(0, 256, 1 << 22, dtype=np.uint8)  # 4 MB pattern
+    t0 = time.time()
+    with mfile.MFileWriter(mpath + ".part", spec) as w:
+        for tinfo in w.plan:
+            n = int(np.prod(tinfo.shape))
+            if tinfo.ftype == quants.Q40:
+                blocks = n // 32
+                arr = np.empty((blocks, quants.Q40_BLOCK_BYTES), np.uint8)
+                arr[:, :2] = scale
+                arr[:, 2:] = np.resize(nib_pool, (blocks, 16))
+                w.write_raw(tinfo.name, arr)
+            else:  # f32 norms/embedding in non-Q40 plans
+                w.write_tensor(tinfo.name,
+                               (rng.randn(*tinfo.shape) * 0.02).astype(np.float32))
+    os.replace(mpath + ".part", mpath)
+    print(f"bench: synthesized {mpath} "
+          f"({os.path.getsize(mpath) / 1e9:.2f} GB in {time.time() - t0:.0f}s)",
+          file=sys.stderr)
+    return mpath, tpath
+
+
+def _run_cli_bench(name, steps=320, chunk=32):
+    """Drive `dllama inference` end-to-end (loader → Engine →
+    generate_stream → G/I/T print) and parse its run averages."""
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    mpath, tpath = _synth_model_files(name, os.environ.get("BENCH_TMP", "/tmp"))
+    cmd = [sys.executable, "-m", "dllama_tpu", "inference", "--model", mpath,
+           "--tokenizer", tpath, "--prompt", "hello hello hello", "--steps",
+           # warmup == steps: the warmup pass replays the exact chunk-size
+           # sequence of the timed pass, so every program is compiled before
+           # timing starts
+           str(steps), "--chunk", str(chunk), "--warmup", str(steps),
+           "--temperature", "0", "--seed", "0"]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        r = subprocess.run(cmd, cwd=here, stdout=subprocess.PIPE, text=True,
+                           env=env,
+                           timeout=float(os.environ.get("BENCH_CLI_TIMEOUT_S", "780")))
+    except subprocess.TimeoutExpired:
+        raise RuntimeError("CLI bench timed out (child killed)")
+    sys.stderr.write("\n".join(r.stdout.splitlines()[-8:]) + "\n")
+    if r.returncode != 0:
+        raise RuntimeError(f"CLI bench rc={r.returncode}")
+    m = re.search(r"Avg generation time:\s+([0-9.]+) ms", r.stdout)
+    if not m:
+        raise RuntimeError("CLI bench output had no 'Avg generation time'")
+    return float(m.group(1))
+
+
 def _pallas_hw_check():
     """Non-interpret fused-kernel equality check on the real backend
     (VERDICT r01: Mosaic breakage must be visible in the artifact).
@@ -166,6 +243,16 @@ def run_attempt(name):
         devs = jax.devices()
         print(json.dumps({"platform": jax.default_backend(),
                           "devices": [str(d) for d in devs]}))
+        return
+
+    if name == "llama2-7b-cli":
+        ms = _run_cli_bench("llama2-7b")
+        print(json.dumps({
+            "metric": "llama2-7b q40 greedy decode tok/s "
+                      "(1 TPU chip, dllama inference CLI end-to-end)",
+            "value": round(1000.0 / ms, 2), "unit": "tok/s",
+            "vs_baseline": round(1000.0 / ms / BASELINE_7B_TOKS, 2),
+            "backend": jax.default_backend()}))
         return
 
     cfg = _model_cfg(name)
@@ -242,15 +329,31 @@ def main():
     on_hw = probe is not None and probe.get("platform") != "cpu"
 
     if on_hw:
+        chunk_out = None
         for name in ("llama2-7b", "tinyllama-1.1b"):
             budget = remaining() - 360  # keep room for the CPU fallback
             if budget < 180:
                 print("bench: budget exhausted, skipping to fallback", file=sys.stderr)
                 break
-            out = _spawn(name, min(budget, 1200))
-            if out:
-                _emit(out)
+            chunk_out = _spawn(name, min(budget, 900))
+            if chunk_out:
+                break
+        # the operator-surface run (synth .m → loader → Engine → CLI stats)
+        # is the headline number when it completes (VERDICT r02 Next #3);
+        # the decode_chunk number above remains the recorded cross-check.
+        # Only attempted when the 7B shape itself just worked — a tinyllama
+        # fallback means 7B failed and re-running it would burn the budget.
+        if chunk_out and "llama2-7b" in chunk_out.get("metric", "") \
+                and remaining() > 480:
+            cli_out = _spawn("llama2-7b-cli", remaining() - 150)
+            if cli_out:
+                print(f"bench: decode_chunk cross-check: {json.dumps(chunk_out)}",
+                      file=sys.stderr)
+                _emit(cli_out)
                 return
+        if chunk_out:
+            _emit(chunk_out)
+            return
     else:
         print("bench: TPU backend unreachable — degraded CPU mode", file=sys.stderr)
 

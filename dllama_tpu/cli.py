@@ -90,6 +90,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dequantize", action="store_true",
                    help="load Q40 weights as dense bf16 instead of the packed "
                         "fused-kernel path (debugging / numerics comparison)")
+    p.add_argument("--warmup", type=int, default=0,
+                   help="inference mode: generate this many throwaway tokens "
+                        "first (compiles the prefill bucket and decode chunks) "
+                        "so the timed stats measure steady state, not XLA "
+                        "compilation; 0 = reference parity (it has no compile)")
     p.add_argument("--profile-split", action="store_true",
                    help="inference mode: after the run, trace a few decode steps "
                         "with the XLA profiler and report compute vs collective "
@@ -147,6 +152,15 @@ def cmd_inference(args) -> None:
     if args.chunk > 1:
         print(f"💡 decode runs on-device in chunks of {args.chunk}; G/I/T "
               "lines within a chunk are that chunk's per-token averages")
+    if args.warmup > 0:
+        t0 = time.perf_counter()
+        for _ in engine.generate_stream(
+                ids, len(ids) + args.warmup, temperature=args.temperature,
+                topp=args.topp, seed=_seed(args), chunk=args.chunk):
+            pass
+        engine.reset()
+        print(f"💡 warmup: {args.warmup} tokens in "
+              f"{time.perf_counter() - t0:.1f}s (compile excluded from stats)")
     stats = RunStats()
     pieces = []
     prev = tok.bos_id

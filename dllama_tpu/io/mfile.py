@@ -310,6 +310,23 @@ class MFileWriter:
         self._f.write(quants.quantize_tensor(x, expect.ftype))
         self._i += 1
 
+    def write_raw(self, name: str, raw: np.ndarray | bytes) -> None:
+        """Write a tensor's already-encoded bytes (size-checked against the
+        plan).  Lets large fixtures/benchmark models be synthesized at
+        packed size with no f32 transit — the quantized analogue of the
+        reference's direct block writes (writer.py:29-78)."""
+        expect = self.plan[self._i]
+        if name != expect.name:
+            raise ValueError(f"tensor order mismatch: got {name}, want {expect.name}")
+        n = int(np.prod(expect.shape))
+        want = quants.batch_bytes(expect.ftype, n)
+        raw = np.asarray(raw, np.uint8) if not isinstance(raw, bytes) else raw
+        got = raw.nbytes if isinstance(raw, np.ndarray) else len(raw)
+        if got != want:
+            raise ValueError(f"{name}: raw payload {got} B != expected {want} B")
+        self._f.write(raw.tobytes() if isinstance(raw, np.ndarray) else raw)
+        self._i += 1
+
     def close(self):
         if self._i != len(self.plan):
             raise ValueError(f"file incomplete: {self._i}/{len(self.plan)} tensors written")

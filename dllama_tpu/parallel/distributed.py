@@ -24,14 +24,18 @@ from __future__ import annotations
 import os
 
 
-def distributed_env() -> tuple[str, int, int] | None:
-    """Read process coordinates from the environment, or ``None``."""
+def distributed_env() -> tuple[str | None, int | None, int | None] | None:
+    """Read process coordinates from the environment, or ``None`` when no
+    DLLAMA_* coordinate is set.  Unset fields stay ``None`` so the
+    nproc>1-requires-proc-id validation applies to the env path too."""
     coord = os.environ.get("DLLAMA_COORDINATOR")
-    if not coord:
+    nproc = os.environ.get("DLLAMA_NPROC")
+    pid = os.environ.get("DLLAMA_PROC_ID")
+    if not coord and nproc is None and pid is None:
         return None
-    return (coord,
-            int(os.environ.get("DLLAMA_NPROC", "1")),
-            int(os.environ.get("DLLAMA_PROC_ID", "0")))
+    return (coord or None,
+            int(nproc) if nproc is not None else None,
+            int(pid) if pid is not None else None)
 
 
 def init_distributed(coordinator: str | None = None,
@@ -45,8 +49,13 @@ def init_distributed(coordinator: str | None = None,
     (hostenv.py).
     """
     env = distributed_env()
-    if coordinator is None and env is not None:
-        coordinator, num_processes, process_id = env
+    if env is not None:
+        # flags win per field; env fills the gaps (a scheduler may export
+        # per-host DLLAMA_PROC_ID while the flags are identical everywhere)
+        ec, en, ep = env
+        coordinator = coordinator if coordinator is not None else ec
+        num_processes = num_processes if num_processes is not None else en
+        process_id = process_id if process_id is not None else ep
     if coordinator is None:
         raise ValueError(
             "multi-host init needs --coordinator host:port (+ --nproc/--proc-id) "

@@ -87,6 +87,30 @@ def test_mfile_size_mismatch_raises(tmp_path):
         mfile.MFile(path)
 
 
+def test_write_raw_equals_write_tensor(tmp_path):
+    """write_raw with pre-encoded bytes produces a byte-identical file to
+    write_tensor quantizing the same values (the synth-bench path)."""
+    spec = tiny_spec(ftype=quants.Q40)
+    rng = np.random.RandomState(3)
+    tensors = {t.name: rng.randn(*t.shape).astype(np.float32) * 0.05
+               for t in mfile.tensor_plan(spec)}
+    a, b = tmp_path / "a.m", tmp_path / "b.m"
+    with mfile.MFileWriter(a, spec) as w:
+        for t in w.plan:
+            w.write_tensor(t.name, tensors[t.name])
+    with mfile.MFileWriter(b, spec) as w:
+        for t in w.plan:
+            w.write_raw(t.name, quants.quantize_tensor(tensors[t.name], t.ftype))
+    assert a.read_bytes() == b.read_bytes()
+
+
+def test_write_raw_size_checked(tmp_path):
+    spec = tiny_spec(ftype=quants.Q40)
+    with pytest.raises(ValueError, match="raw payload"):
+        with mfile.MFileWriter(tmp_path / "x.m", spec) as w:
+            w.write_raw(w.plan[0].name, b"\x00" * 7)
+
+
 def test_q40_planes_from_file(tmp_path):
     spec = tiny_spec(ftype=quants.Q40)
     path = tmp_path / "model.m"
