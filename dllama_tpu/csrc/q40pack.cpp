@@ -1,0 +1,59 @@
+// Native Q40 loader transform: `.m` file blocks → runtime packed layout.
+//
+// The runtime stores a (d_out, n_in) Q40 weight input-dim-first as
+//   qpacked u8  (padded_n/2, d)   row 16b+r = file nibble byte r of block b
+//   scales  f16 (padded_n/32, d)
+// (see dllama_tpu/ops/q40.py).  A file block for output row dd covering
+// input positions [32b, 32b+32) is 18 bytes: f16 scale + 16 nibble bytes
+// whose lo/hi split matches the runtime's (BlockQ40, quants.hpp:17-20 in
+// the reference), so the whole transform is a blocked byte transpose —
+// no nibble arithmetic.  The Python fallback (quants.q40_planes +
+// pack_planes_np) materializes a dense int8 (d, n) plane and a full
+// transpose per tensor; this single pass replaces it on the load path,
+// the native runtime component the reference implements as
+// Transformer::loadRoot/splitWeights (transformer.cpp:389-487).
+//
+// Build: make -C dllama_tpu/csrc    (produces libq40pack.so; the loader
+// falls back to numpy when the library is absent).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+constexpr int64_t kBlockBytes = 18;  // 2 f16 scale + 16 nibble bytes
+constexpr int64_t kTileD = 64;       // dd tile: src tile = 64*8*18 B ≈ 9 KB
+constexpr int64_t kTileB = 8;        // block tile (128 output rows)
+}  // namespace
+
+extern "C" {
+
+// raw:     d*nb file blocks, row-major by output row dd
+// qp:      (padded_nb*16, ld) uint8, written at columns [col, col+d)
+// sc:      (padded_nb, ld) uint16 (f16 bits), same column window
+// Rows beyond nb*16 (pack padding) are the caller's to zero-fill.
+void q40_repack(const uint8_t* raw, int64_t d, int64_t nb,
+                uint8_t* qp, uint16_t* sc, int64_t ld, int64_t col) {
+#pragma omp parallel for schedule(static)
+  for (int64_t b0 = 0; b0 < nb; b0 += kTileB) {
+    const int64_t b1 = (b0 + kTileB < nb) ? b0 + kTileB : nb;
+    for (int64_t d0 = 0; d0 < d; d0 += kTileD) {
+      const int64_t d1 = (d0 + kTileD < d) ? d0 + kTileD : d;
+      for (int64_t b = b0; b < b1; ++b) {
+        uint8_t* qrow0 = qp + (b * 16) * ld + col;
+        uint16_t* srow = sc + b * ld + col;
+        for (int64_t dd = d0; dd < d1; ++dd) {
+          const uint8_t* blk = raw + (dd * nb + b) * kBlockBytes;
+          uint16_t s;
+          std::memcpy(&s, blk, 2);
+          srow[dd] = s;
+          const uint8_t* nib = blk + 2;
+          for (int64_t r = 0; r < 16; ++r) {
+            qrow0[r * ld + dd] = nib[r];
+          }
+        }
+      }
+    }
+  }
+}
+
+}  // extern "C"

@@ -82,18 +82,20 @@ def _stack(mf: mfile.MFile, names: list[str], transpose: bool, dtype) -> np.ndar
 def _stack_q(mf: mfile.MFile, names: list[str | list[str]]) -> q40.QTensor:
     """Layer-stack Q40 tensors straight from their packed file bytes —
     the weights never touch f32 on host (the reference likewise keeps Q40
-    end-to-end on its production path, funcs.cpp:287-386).
+    end-to-end on its production path, funcs.cpp:287-386); the repack is a
+    byte transpose per tensor (native csrc/q40pack.cpp when built).
 
     An inner list of names concatenates those tensors' output dims into one
     fused weight (e.g. q+k+v), which halves-again the fused kernel's launch
     count per layer."""
-    qs, ss = [], []
-    for name in names:
-        group = [name] if isinstance(name, str) else name
-        planes = [mf.q40_planes(g) for g in group]   # (d_out, n_in) each
-        qs.append(np.concatenate([p[0] for p in planes], axis=0))
-        ss.append(np.concatenate([p[1] for p in planes], axis=0))
-    return q40.pack_planes_t(np.stack(qs), np.stack(ss))
+    def entry(name):
+        t = mf.by_name[name]
+        d = int(np.prod(t.shape[:-1]))
+        return (mf.raw(name), d, t.shape[-1])
+
+    groups = [[entry(g) for g in ([name] if isinstance(name, str) else name)]
+              for name in names]
+    return q40.pack_file_groups(groups)
 
 
 def quantize_matmuls(params: Params, cfg: ModelConfig,
@@ -136,20 +138,17 @@ def _stack_q_experts(mf: mfile.MFile, cfg: ModelConfig, fname: str) -> q40.QTens
     packed size (~0.69 B/weight).  Replaces the dense f32 expert loading
     that made Mixtral-8x7B (~90 GB f32 transit) unloadable (VERDICT r01)."""
     L, E = cfg.n_layers, cfg.n_experts
-    first = q40.pack_planes_np(
-        *(np.swapaxes(p, -1, -2) for p in mf.q40_planes(f"layers.0.experts.0.{fname}")))
-    qp0, sc0, nd = first
-    qp = np.empty((L, E) + qp0.shape, np.uint8)
-    sc = np.empty((L, E) + sc0.shape, np.float16)
+    t0 = mf.by_name[f"layers.0.experts.0.{fname}"]
+    d = int(np.prod(t0.shape[:-1]))
+    n = t0.shape[-1]
+    np_ = q40.padded_n(n)
+    qp = np.zeros((L, E, np_ // 2, d), np.uint8)
+    sc = np.zeros((L, E, np_ // 32, d), np.float16)
     for l in range(L):
         for e in range(E):
-            if l == 0 and e == 0:
-                qp[0, 0], sc[0, 0] = qp0, sc0
-                continue
-            planes = mf.q40_planes(f"layers.{l}.experts.{e}.{fname}")
-            qp[l, e], sc[l, e], _ = q40.pack_planes_np(
-                *(np.swapaxes(p, -1, -2) for p in planes))
-    return q40.QTensor(jnp.asarray(qp), jnp.asarray(sc), nd)
+            q40.repack_file_bytes_into(
+                mf.raw(f"layers.{l}.experts.{e}.{fname}"), d, n, qp[l, e], sc[l, e])
+    return q40.QTensor(jnp.asarray(qp), jnp.asarray(sc), (n, d))
 
 
 def load_params(mf: mfile.MFile, cfg: ModelConfig | None = None,
@@ -222,7 +221,10 @@ def load_params(mf: mfile.MFile, cfg: ModelConfig | None = None,
             p[key] = _stack(mf, [f"layers.{i}.{key}" for i in range(L)], True, np_dtype)
     p["rms_final"] = mf.tensor("rms_final").astype(np.float32)
     if quant:
-        p["wcls"] = q40.pack_planes_t(*mf.q40_planes("wcls"))
+        tw = mf.by_name["wcls"]
+        p["wcls"] = q40.pack_file_groups(
+            [[(mf.raw("wcls"), int(np.prod(tw.shape[:-1])), tw.shape[-1])]],
+            stacked=False)
     else:
         p["wcls"] = np.ascontiguousarray(mf.tensor("wcls").T).astype(np_dtype)
     return cfg, {k: v if isinstance(v, q40.QTensor) else jnp.asarray(v)

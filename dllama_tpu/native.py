@@ -1,0 +1,84 @@
+"""ctypes bindings for the native runtime components (csrc/).
+
+The reference implements its whole runtime in C++; here the TPU compute
+path is XLA's, and the host-side hot paths are native instead — currently
+the Q40 load transform (csrc/q40pack.cpp), which turns `.m` file blocks
+into the runtime packed layout in one parallel pass.  Everything degrades
+to the numpy implementation when the shared library hasn't been built
+(`make -C dllama_tpu/csrc`), so the package stays importable anywhere.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import os
+
+import numpy as np
+
+_LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "csrc", "libq40pack.so")
+
+
+@functools.cache
+def _lib():
+    """The loaded library, or ``None`` (not built / load failure).
+
+    When the .so is absent (it is machine-specific, never committed) a
+    one-shot build is attempted — a 2 s compile that keeps fresh checkouts
+    on the fast path; any failure falls back to numpy silently."""
+    if os.environ.get("DLLAMA_NO_NATIVE"):
+        return None
+    if not os.path.exists(_LIB_PATH):
+        import subprocess
+        try:
+            subprocess.run(["make", "-C", os.path.dirname(_LIB_PATH)],
+                           capture_output=True, timeout=60, check=False)
+        except Exception:
+            pass
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.q40_repack.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+    lib.q40_repack.restype = None
+    return lib
+
+
+def have_native() -> bool:
+    return _lib() is not None
+
+
+def q40_repack_into(raw: np.ndarray, d: int, n: int,
+                    qp: np.ndarray, sc: np.ndarray, col: int) -> None:
+    """Repack one (d, n) Q40 tensor's file bytes into preallocated runtime
+    planes at column offset ``col``.
+
+    ``qp`` is uint8 (padded_n/2, ld), ``sc`` float16 (padded_n/32, ld);
+    rows beyond n/32 blocks must be pre-zeroed by the caller (pack
+    padding).  Requires C-contiguous outputs.
+    """
+    lib = _lib()
+    if lib is None:
+        raise RuntimeError("native library not built (make -C dllama_tpu/csrc)")
+    nb = n // 32
+    if raw.nbytes != d * nb * 18:
+        raise ValueError(f"raw size {raw.nbytes} != {d * nb * 18}")
+    if not (qp.flags.c_contiguous and sc.flags.c_contiguous):
+        raise ValueError("output planes must be C-contiguous")
+    if qp.dtype != np.uint8 or sc.dtype != np.float16:
+        raise ValueError("qp must be uint8, sc float16")
+    ld = qp.shape[-1]
+    if sc.shape[-1] != ld or col + d > ld:
+        raise ValueError(f"column window [{col}, {col + d}) exceeds ld={ld}")
+    if qp.shape[0] < nb * 16 or sc.shape[0] < nb or qp.shape[0] != 16 * sc.shape[0]:
+        raise ValueError(
+            f"plane rows (qp {qp.shape[0]}, sc {sc.shape[0]}) too small for "
+            f"{nb} blocks — the native write would run out of bounds")
+    raw = np.ascontiguousarray(raw)
+    lib.q40_repack(
+        raw.ctypes.data_as(ctypes.c_void_p), d, nb,
+        qp.ctypes.data_as(ctypes.c_void_p),
+        sc.ctypes.data_as(ctypes.c_void_p), ld, col)

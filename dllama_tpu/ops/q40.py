@@ -64,9 +64,10 @@ from .. import quants
 from ..parallel.mesh import get_active_mesh
 
 # Sweet spot measured on v5e (HBM-roofline for the 4096×11008 matvec);
-# shrunk automatically when N or D is smaller.
-TILE_N = 1024
-TILE_D = 1024
+# shrunk automatically when N or D is smaller.  Env-overridable so
+# tools/sweep_q40.py can explore the tile space on hardware without edits.
+TILE_N = int(os.environ.get("DLLAMA_Q40_TILE_N", "1024"))
+TILE_D = int(os.environ.get("DLLAMA_Q40_TILE_D", "1024"))
 # Decode uses the Pallas kernel; past this many rows the matmul is MXU-bound
 # and the XLA path (which can pipeline the dequant) is preferable.
 PALLAS_MAX_ROWS = 128
@@ -166,6 +167,62 @@ def from_q40_bytes(raw: np.ndarray, d_out: int, n_in: int) -> QTensor:
     """Build a QTensor from reference `.m`-format Q40 bytes of a row-major
     ``(d_out, n_in)`` weight (the on-disk layout, transformer.cpp:389-404)."""
     return pack_planes_t(*quants.q40_planes(raw, (d_out, n_in)))
+
+
+def repack_file_bytes_into(raw: np.ndarray, d: int, n: int,
+                           qp2: np.ndarray, sc2: np.ndarray, col: int = 0) -> None:
+    """Repack one (d, n) tensor's `.m` Q40 bytes straight into preallocated
+    runtime planes (``qp2`` u8 (padded_n/2, ld), ``sc2`` f16 (padded_n/32,
+    ld)) at output-column offset ``col``.
+
+    The file's per-block lo/hi nibble split matches the runtime layout
+    (BlockQ40, quants.hpp:17-20), so this is a pure byte transpose: the
+    native single-pass repacker (csrc/q40pack.cpp) when built, else a
+    numpy blocked transpose — either way no dense int8 plane and no f32
+    transit.  Rows past n's blocks (pack padding) are left untouched: the
+    caller pre-zeroes them, and zero scales null the padding's dot-product
+    contribution."""
+    from ..native import have_native, q40_repack_into
+
+    nb = n // 32
+    if have_native():
+        q40_repack_into(raw, d, n, qp2, sc2, col)
+        return
+    blocks = np.asarray(raw, np.uint8).reshape(d, nb, quants.Q40_BLOCK_BYTES)
+    sc2[:nb, col:col + d] = (
+        np.ascontiguousarray(blocks[:, :, :2]).view(np.float16).reshape(d, nb).T)
+    nib = np.moveaxis(blocks[:, :, 2:], 0, 2)       # (nb, 16, d)
+    qp2[:nb * 16, col:col + d] = nib.reshape(nb * 16, d)
+
+
+def pack_file_groups(groups: list[list[tuple[np.ndarray, int, int]]],
+                     stacked: bool = True) -> QTensor:
+    """Layer-stacked QTensor straight from `.m` file bytes.
+
+    ``groups[l]`` is a list of ``(raw_bytes, d_out, n_in)`` whose output
+    dims concatenate into one fused weight (e.g. q|k|v).  Replaces the
+    q40_planes → concat → transpose → repack pipeline with one repack per
+    tensor into a preallocated stack (native csrc/q40pack.cpp when built).
+    ``stacked=False`` with a single group returns the 2-D QTensor (wcls).
+    """
+    n = groups[0][0][2]
+    d_total = sum(g[1] for g in groups[0])
+    L = len(groups)
+    np_ = padded_n(n)
+    qp = np.zeros((L, np_ // 2, d_total), np.uint8)
+    sc = np.zeros((L, np_ // 32, d_total), np.float16)
+    for l, group in enumerate(groups):
+        col = 0
+        for raw, d, gn in group:
+            if gn != n:
+                raise ValueError(f"fused group mixes input dims {gn} != {n}")
+            repack_file_bytes_into(raw, d, n, qp[l], sc[l], col)
+            col += d
+    if not stacked:
+        if L != 1:
+            raise ValueError("stacked=False needs exactly one group")
+        return QTensor(jnp.asarray(qp[0]), jnp.asarray(sc[0]), (n, d_total))
+    return QTensor(jnp.asarray(qp), jnp.asarray(sc), (n, d_total))
 
 
 def split_d(qt: QTensor, sizes: list[int]) -> list[QTensor]:

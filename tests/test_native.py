@@ -1,0 +1,87 @@
+"""Native loader component (csrc/q40pack.cpp + native.py bindings).
+
+The native repack and the numpy fallback must produce byte-identical
+runtime planes, and both must agree with the original (slow) reference
+pipeline q40_planes → transpose → pack_planes_np."""
+
+import numpy as np
+import pytest
+
+from dllama_tpu import native, quants
+from dllama_tpu.ops import q40
+
+
+def _file_bytes(d, n, seed=0):
+    w = (np.random.RandomState(seed).randn(d, n) * 0.1).astype(np.float32)
+    return np.frombuffer(quants.quantize_q40(w), np.uint8), w
+
+
+def _repack(raw, d, n, use_native):
+    np_ = q40.padded_n(n)
+    qp = np.zeros((np_ // 2, d), np.uint8)
+    sc = np.zeros((np_ // 32, d), np.float16)
+    if use_native:
+        native.q40_repack_into(raw, d, n, qp, sc, 0)
+    else:
+        import unittest.mock as mock
+        with mock.patch.object(native, "have_native", return_value=False):
+            q40.repack_file_bytes_into(raw, d, n, qp, sc, 0)
+    return qp, sc
+
+
+def test_numpy_repack_matches_reference_pipeline():
+    d, n = 48, 96
+    raw, _ = _file_bytes(d, n)
+    qp, sc = _repack(raw, d, n, use_native=False)
+    ref = q40.pack_planes_t(*quants.q40_planes(raw, (d, n)))
+    np.testing.assert_array_equal(qp, np.asarray(ref.qpacked))
+    np.testing.assert_array_equal(sc, np.asarray(ref.scales))
+
+
+@pytest.mark.skipif(not native.have_native(), reason="libq40pack.so not built")
+def test_native_repack_matches_numpy():
+    for d, n in [(48, 96), (64, 2048), (129, 32), (1000, 352)]:
+        raw, _ = _file_bytes(d, n, seed=d)
+        a = _repack(raw, d, n, use_native=True)
+        b = _repack(raw, d, n, use_native=False)
+        np.testing.assert_array_equal(a[0], b[0])
+        np.testing.assert_array_equal(a[1], b[1])
+
+
+@pytest.mark.skipif(not native.have_native(), reason="libq40pack.so not built")
+def test_native_repack_column_offset():
+    """Fused groups write adjacent column windows of one plane."""
+    d1, d2, n = 32, 48, 64
+    r1, w1 = _file_bytes(d1, n, seed=1)
+    r2, w2 = _file_bytes(d2, n, seed=2)
+    np_ = q40.padded_n(n)
+    qp = np.zeros((np_ // 2, d1 + d2), np.uint8)
+    sc = np.zeros((np_ // 32, d1 + d2), np.float16)
+    native.q40_repack_into(r1, d1, n, qp, sc, 0)
+    native.q40_repack_into(r2, d2, n, qp, sc, d1)
+    qt = q40.QTensor(qp, sc, (n, d1 + d2))
+    deq = np.asarray(q40.dequantize(qt))
+    exp1 = quants.dequantize_q40(r1, d1 * n).reshape(d1, n).T
+    exp2 = quants.dequantize_q40(r2, d2 * n).reshape(d2, n).T
+    np.testing.assert_allclose(deq[:, :d1], exp1, atol=0)
+    np.testing.assert_allclose(deq[:, d1:], exp2, atol=0)
+
+
+def test_pack_file_groups_end_to_end(tmp_path):
+    """load_params' Q40 path (now through pack_file_groups) dequantizes to
+    the same values as MFile.tensor."""
+    from tests.fixtures import write_tiny_model
+    from dllama_tpu.io import mfile
+    from dllama_tpu.models.config import ModelConfig
+    from dllama_tpu.models.params import load_params
+
+    path = tmp_path / "m.m"
+    write_tiny_model(str(path), ftype=quants.Q40, vocab_size=64, seq_len=32)
+    mf = mfile.MFile(str(path))
+    cfg = ModelConfig.from_spec(mf.spec)
+    _, params = load_params(mf, cfg, keep_quantized=True, fuse=True)
+    wqkv = np.asarray(q40.dequantize(params["wqkv"]))
+    expect = np.concatenate(
+        [mf.tensor("layers.0.wq").T, mf.tensor("layers.0.wk").T,
+         mf.tensor("layers.0.wv").T], axis=1)
+    np.testing.assert_allclose(wqkv[0], expect, atol=1e-7)

@@ -1,0 +1,133 @@
+"""Hardware sweep of the fused Q40 kernel: variants × tile sizes.
+
+Times the layer-stacked kernel (the decode hot path) on the llama2-7B
+matmul shapes for each (variant, tile_n, tile_d) configuration — each in a
+fresh subprocess because TILE_N governs the packed storage layout — and
+prints effective HBM bandwidth + a projected decode tok/s so the winning
+config can be made the default with evidence (VERDICT r02 Next #2).
+
+Usage: python tools/sweep_q40.py            # sweep and rank
+       python tools/sweep_q40.py --one folded 1024 1024   # single config
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+def shapes():
+    """llama2-7B per-layer matmuls (stacked over 32 layers) + wcls, as
+    (name, n_in, d_out, stacked_layers).  w2's input dim is padded exactly
+    as real packing pads it under the active TILE_N (q40.padded_n)."""
+    from dllama_tpu.ops import q40
+    return [
+        ("wqkv", 4096, 12288, 32),
+        ("wo", 4096, 4096, 32),
+        ("w13", 4096, 22016, 32),
+        ("w2", q40.padded_n(11008), 4096, 32),
+        ("wcls", 4096, 32000, 1),
+    ]
+
+CONFIGS = [
+    ("classic", 1024, 1024), ("folded", 1024, 1024), ("exact", 1024, 1024),
+    ("classic", 512, 1024), ("folded", 512, 1024),
+    ("classic", 1024, 2048), ("folded", 1024, 2048),
+    ("classic", 2048, 1024), ("folded", 2048, 1024),
+    ("classic", 1024, 512), ("folded", 1024, 512),
+]
+
+
+def measure_one(variant: str, reps: int = 30) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    sys.path.insert(0, HERE)
+    from dllama_tpu.ops import q40
+
+    if jax.default_backend() == "cpu":
+        print(json.dumps({"error": "no TPU"}))
+        return {}
+    rng = np.random.RandomState(0)
+    out = {"variant": variant, "tile_n": q40.TILE_N, "tile_d": q40.TILE_D,
+           "shapes": {}}
+    total_ms = 0.0
+    total_bytes = 0
+    for name, n, d, L in shapes():
+        nb = n // 32
+        qp = jnp.asarray(rng.randint(0, 256, (L, n // 2, d), dtype=np.uint8))
+        sc = jnp.asarray((rng.rand(L, nb, d).astype(np.float16) * 0.01))
+        x = jnp.asarray(rng.randn(1, n).astype(np.float32), jnp.bfloat16)
+        lidx = jnp.int32(0)
+
+        fn = lambda xx, l: q40._pallas_matmul_stacked(xx, qp, sc, l, variant=variant)
+        r = fn(x, lidx)
+        r.block_until_ready()
+        # cycle the layer index so HBM reads are not cache-resident
+        t0 = time.perf_counter()
+        for i in range(reps):
+            r = fn(x, jnp.int32(i % L))
+        r.block_until_ready()
+        ms = (time.perf_counter() - t0) * 1000 / reps
+        nbytes = (n // 2) * d + nb * d * 2  # packed + f16 scales per layer
+        gbps = nbytes / ms / 1e6
+        out["shapes"][name] = {"ms": round(ms, 4), "GBps": round(gbps, 1)}
+        total_ms += ms * L
+        total_bytes += nbytes * L
+    out["proj_matmul_ms_per_token"] = round(total_ms, 3)
+    out["proj_matmul_GBps"] = round(total_bytes / total_ms / 1e6, 1)
+    print(json.dumps(out))
+    return out
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--one":
+        if len(sys.argv) > 4:
+            # tiles must be in the env before the q40 import inside
+            # measure_one reads them
+            os.environ["DLLAMA_Q40_TILE_N"] = sys.argv[3]
+            os.environ["DLLAMA_Q40_TILE_D"] = sys.argv[4]
+        measure_one(sys.argv[2])
+        return
+    results = []
+    for variant, tn, td in CONFIGS:
+        env = dict(os.environ)
+        env["DLLAMA_Q40_TILE_N"] = str(tn)
+        env["DLLAMA_Q40_TILE_D"] = str(td)
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--one", variant],
+                stdout=subprocess.PIPE, env=env, cwd=HERE, timeout=420)
+        except subprocess.TimeoutExpired:
+            print(f"{variant} tn={tn} td={td}: TIMEOUT", file=sys.stderr)
+            continue
+        if r.returncode != 0:
+            print(f"{variant} tn={tn} td={td}: rc={r.returncode}", file=sys.stderr)
+            continue
+        try:
+            out = json.loads(r.stdout.decode().strip().splitlines()[-1])
+        except Exception:
+            print(f"{variant} tn={tn} td={td}: unparseable", file=sys.stderr)
+            continue
+        if "error" in out:
+            print(f"{variant} tn={tn} td={td}: {out['error']}", file=sys.stderr)
+            continue
+        results.append(out)
+        print(f"{variant:8s} tn={tn:<5d} td={td:<5d} "
+              f"matmuls {out['proj_matmul_ms_per_token']:7.2f} ms/tok "
+              f"@ {out['proj_matmul_GBps']:6.1f} GB/s", file=sys.stderr)
+    results.sort(key=lambda r: r["proj_matmul_ms_per_token"])
+    print("\n=== ranked ===", file=sys.stderr)
+    for r in results[:5]:
+        print(f"{r['variant']:8s} tn={r['tile_n']:<5d} td={r['tile_d']:<5d} "
+              f"{r['proj_matmul_ms_per_token']:7.2f} ms/tok "
+              f"{r['proj_matmul_GBps']:6.1f} GB/s", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
