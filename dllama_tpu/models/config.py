@@ -41,6 +41,11 @@ class ModelConfig:
     # inputs, xla otherwise).  Static so each choice compiles its own
     # program.
     quant_impl: str = "auto"
+    # static flag set by the engine for a from-scratch prefill on an sp>1
+    # mesh: attention runs blockwise ring attention over the fresh
+    # sequence-sharded q/k/v (ops/sp_attention.py) instead of the
+    # cache-reading one-round combine — O(T/sp) activation memory
+    ring_prefill: bool = False
 
     @property
     def head_size(self) -> int:

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from ..ops import q40
 from ..ops.attention import gqa_attention, update_kv_cache
 from ..ops.kernels import ACTIVATIONS, apply_rope, rmsnorm, rope_angles, softmax_f32
-from ..ops.sp_attention import sp_gqa_attention
+from ..ops.sp_attention import ring_attention, sp_gqa_attention
 from ..parallel.mesh import get_active_mesh
 from .config import ModelConfig
 from .params import Params
@@ -92,8 +92,15 @@ def _attention_block(x, lp, cfg: ModelConfig, k_cache, v_cache, cos, sin, pos):
 
     mesh = get_active_mesh()
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
-        # sequence-parallel: seq-sharded cache, distributed softmax combine
-        att = sp_gqa_attention(q, k_cache, v_cache, pos, t, mesh)
+        if cfg.ring_prefill and t > 1:
+            # from-scratch prefill: the fresh block IS the whole history
+            # (engine gates this on pos==0), so attend blockwise over the
+            # sequence-sharded q/k/v ring — no cache read, O(T/sp) memory
+            att = ring_attention(q, k, v, mesh, pos0=pos)
+        else:
+            # sequence-parallel decode / continuation: seq-sharded cache,
+            # one-round distributed softmax combine
+            att = sp_gqa_attention(q, k_cache, v_cache, pos, t, mesh)
     else:
         att = gqa_attention(q, k_cache, v_cache, pos, t)
     att = att.transpose(0, 2, 1, 3).reshape(b, t, hq * dh)

@@ -56,6 +56,91 @@ def _local_partials(q, k, v, pos, q_len, chunk_start):
     return o_i, l_i, m_i
 
 
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh,
+                   pos0: jax.Array | int = 0,
+                   q_spec: P = P("dp", "tp", "sp", None),
+                   kv_spec: P = P("dp", "tp", "sp", None)) -> jax.Array:
+    """Causal GQA ring attention for a sequence-sharded *from-scratch*
+    prefill.
+
+    Blockwise ring attention (Liu & Abbeel's ring attention shape, built
+    from the same flash softmax decomposition as the decode combine
+    above): queries AND keys/values are sharded on the sequence axis over
+    ``sp``; each of the sp steps computes local partials against the
+    currently-held KV block, folds them into a running (max, denominator,
+    numerator) accumulator, and rotates the KV block to the next shard
+    with ``ppermute`` — XLA overlaps the rotation with the next block's
+    compute on ICI; the last block is consumed without a rotation
+    (sp−1 rotations total).  Blocks that are entirely in a query shard's
+    future are skipped under ``lax.cond`` — they are fully causally
+    masked, and skipping recovers the ~half of block-pair FLOPs a plain
+    ring wastes.  Peak per-chip memory is O(T/sp), which is what lets a
+    prompt longer than one chip's HBM prefill at all; the reference has
+    no analogue (its seqLen is a hard per-node ceiling, commands.hpp:12).
+
+    q: (B, Hq, T, Dh), k/v: (B, Hkv, T, Dh), all with T sharded on
+    ``sp``.  ``pos0`` offsets the global RoPE-free position bookkeeping
+    only; attention covers *only these q/k/v* — any cached KV prefix is
+    NOT read, so callers continuing a sequence (pos0 > 0 with earlier
+    cache content) must use :func:`sp_gqa_attention` instead (the engine
+    gates the ring on ``pos == 0``).  Returns (B, Hq, T, Dh) sharded
+    like q.
+    """
+    b, hq, t, dh = q.shape
+    sp = mesh.shape.get("sp", 1)
+    t_local = t // sp
+    perm = [(i, (i + 1) % sp) for i in range(sp)]  # ring: shard i → i+1
+
+    def shard_fn(q, k, v):
+        hq_l, hkv_l = q.shape[1], k.shape[1]
+        g = hq_l // hkv_l
+        qf = q.astype(jnp.float32).reshape(q.shape[0], hkv_l, g, t_local, dh)
+        my = jax.lax.axis_index("sp")
+        q_start = pos0 + my * t_local
+
+        def accumulate(i, out, lsum, m, kb, vb):
+            # block held after i rotations originated at shard (my-i) mod sp
+            owner = (my - i) % sp
+
+            def fold(args):
+                out, lsum, m = args
+                o_i, l_i, m_i = _local_partials(
+                    qf, kb, vb, q_start, t_local, pos0 + owner * t_local)
+                m_new = jnp.maximum(m, m_i)
+                s_old = jnp.exp(m - m_new)
+                s_new = jnp.exp(m_i - m_new)
+                return (out * s_old[..., None] + o_i * s_new[..., None],
+                        lsum * s_old + l_i * s_new, m_new)
+
+            # owner > my ⇔ every key in the block is a future position for
+            # every query here ⇔ fully masked: skip the whole block
+            return jax.lax.cond(owner <= my, fold, lambda a: a, (out, lsum, m))
+
+        def step(i, carry):
+            out, lsum, m, kb, vb = carry
+            out, lsum, m = accumulate(i, out, lsum, m, kb, vb)
+            kb = jax.lax.ppermute(kb, "sp", perm)
+            vb = jax.lax.ppermute(vb, "sp", perm)
+            return out, lsum, m, kb, vb
+
+        shape = (q.shape[0], hkv_l, g, t_local)
+        varying = lambda x: jax.lax.pcast(x, ("dp", "sp", "tp"), to="varying")
+        # accumulators are per-shard values → mark them device-varying so
+        # the fori_loop carry type matches the loop body's outputs
+        init = (varying(jnp.zeros(shape + (dh,), jnp.float32)),
+                varying(jnp.zeros(shape, jnp.float32)),
+                varying(jnp.full(shape, NEG_BIG, jnp.float32)), k, v)
+        out, lsum, m, kb, vb = jax.lax.fori_loop(0, sp - 1, step, init)
+        # final block: consume without the (discarded) sp-th rotation
+        out, lsum, m = accumulate(sp - 1, out, lsum, m, kb, vb)
+        out = out / jnp.maximum(lsum[..., None], 1e-38)
+        return out.reshape(q.shape[0], hq_l, t_local, dh).astype(q.dtype)
+
+    return jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec)(q, k, v)
+
+
 def sp_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                      pos: jax.Array, q_len: int, mesh,
                      q_spec: P = P("dp", "tp", None, None),

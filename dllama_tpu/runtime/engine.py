@@ -158,6 +158,13 @@ class Engine:
 
         # one compiled program per (batch, T-bucket); decode is bucket T=1
         self._step = jax.jit(step, donate_argnums=(1,), static_argnames=())
+        if self.sp > 1:
+            cfg_ring = cfg.with_(ring_prefill=True)
+
+            def ring_step(params, cache, tokens, pos, last_index):
+                return forward_last(params, cfg_ring, tokens, cache, pos, last_index)
+
+            self._step_ring = jax.jit(ring_step, donate_argnums=(1,))
         self._chunk_fns: dict = {}
         self._key = jax.random.PRNGKey(0)
         self._chunk_counter = 0
@@ -170,10 +177,23 @@ class Engine:
     def _run(self, tokens_np: np.ndarray, last_index: int) -> tuple[np.ndarray, StepStats]:
         stats = StepStats()
         t0 = time.perf_counter()
+        # from-scratch prefill on an sp mesh → blockwise ring attention with
+        # the tokens (and therefore all activations) sharded on the
+        # sequence axis: per-chip activation memory scales 1/sp, which is
+        # what lets a prompt longer than one chip's HBM prefill at all
+        use_ring = (self.sp > 1 and self.pos == 0 and tokens_np.shape[1] > 1
+                    and tokens_np.shape[1] % self.sp == 0)
         with active_mesh(self.mesh):  # read at trace time (first call)
-            logits, self.cache = self._step(
-                self.params, self.cache, jnp.asarray(tokens_np),
-                jnp.int32(self.pos), jnp.int32(last_index))
+            if use_ring:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                toks = jax.device_put(tokens_np, NamedSharding(self.mesh, P("dp", "sp")))
+                logits, self.cache = self._step_ring(
+                    self.params, self.cache, toks,
+                    jnp.int32(self.pos), jnp.int32(last_index))
+            else:
+                logits, self.cache = self._step(
+                    self.params, self.cache, jnp.asarray(tokens_np),
+                    jnp.int32(self.pos), jnp.int32(last_index))
         logits.block_until_ready()
         t1 = time.perf_counter()
         host_logits = np.asarray(logits)  # (B, V)

@@ -66,6 +66,60 @@ class TestOp:
                                    rtol=1e-5, atol=1e-5)
 
 
+class TestRing:
+    """ring_attention: sharded-Q prefill over rotating KV blocks must equal
+    dense causal attention (the same invariance pattern, now with queries
+    sequence-sharded too)."""
+
+    @needs_8
+    @pytest.mark.parametrize("sp,t,pos0", [(4, 32, 0), (8, 64, 0), (4, 16, 8)])
+    def test_matches_dense_causal(self, sp, t, pos0):
+        from dllama_tpu.ops.sp_attention import ring_attention
+
+        mesh = make_mesh(tp=1, sp=sp, dp=1, devices=jax.devices()[:sp])
+        r = np.random.RandomState(1)
+        b, hq, hkv, dh = 1, 4, 2, 8
+        q = jnp.asarray(r.randn(b, hq, t, dh), jnp.float32)
+        k = jnp.asarray(r.randn(b, hkv, t, dh), jnp.float32)
+        v = jnp.asarray(r.randn(b, hkv, t, dh), jnp.float32)
+        # dense reference: full causal self-attention over positions
+        # [pos0, pos0+t) — gqa_attention with the cache holding k/v at
+        # offset... simplest exact reference: manual masked softmax
+        g = hq // hkv
+        qf = np.asarray(q, np.float64).reshape(b, hkv, g, t, dh)
+        kf = np.asarray(k, np.float64)
+        scores = np.einsum("bhgtd,bhsd->bhgts", qf, kf) / np.sqrt(dh)
+        mask = np.tril(np.ones((t, t), bool))
+        scores = np.where(mask[None, None, None], scores, -np.inf)
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bhgts,bhsd->bhgtd", p, np.asarray(v, np.float64))
+        ref = ref.reshape(b, hq, t, dh)
+
+        out = jax.jit(lambda q, k, v: ring_attention(
+            q, k, v, mesh, pos0=pos0,
+            q_spec=jax.sharding.PartitionSpec("dp", "tp", "sp", None),
+        ))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+    @needs_8
+    def test_ring_with_tp(self):
+        from dllama_tpu.ops.sp_attention import ring_attention
+
+        mesh = make_mesh(tp=2, sp=4, dp=1, devices=jax.devices()[:8])
+        r = np.random.RandomState(2)
+        b, hq, hkv, t, dh = 1, 4, 2, 32, 8
+        q = jnp.asarray(r.randn(b, hq, t, dh), jnp.float32)
+        k = jnp.asarray(r.randn(b, hkv, t, dh), jnp.float32)
+        v = jnp.asarray(r.randn(b, hkv, t, dh), jnp.float32)
+        out = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))(q, k, v)
+        # reference via the (already-validated) one-round sp path with a
+        # full cache and t queries at pos 0
+        ref = gqa_attention(q, k, v, jnp.int32(0), t)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
 class TestModel:
     @needs_8
     def test_sp_forward_equivalence(self):
@@ -103,3 +157,30 @@ class TestModel:
         mesh = make_mesh(tp=2, sp=4, dp=1, devices=jax.devices()[:8])
         got = toks(Engine(cfg, params, mesh=mesh))
         assert ref == got
+
+    @needs_8
+    def test_engine_ring_prefill_equivalence(self):
+        """A long from-scratch prompt on an sp mesh takes the ring-prefill
+        path (sequence-sharded tokens, blockwise attention) and still
+        produces the single-device logits + greedy continuation."""
+        cfg = tiny_config(dim=64, hidden_dim=96, n_layers=2, n_heads=4,
+                          n_kv_heads=2, vocab_size=128, seq_len=128)
+        params = init_params(cfg, seed=2)
+        rng = np.random.RandomState(0)
+        prompt = rng.randint(1, 128, 40).tolist()  # bucket 64: divisible by sp=8
+
+        e1 = Engine(cfg, params)
+        mesh = make_mesh(tp=1, sp=8, dp=1, devices=jax.devices()[:8])
+        esp = Engine(cfg, params, mesh=mesh)
+        assert hasattr(esp, "_step_ring")
+        l1, _ = e1.prefill(prompt[:])
+        lsp, _ = esp.prefill(prompt[:])
+        assert esp.pos == len(prompt)
+        np.testing.assert_allclose(lsp, l1, rtol=0,
+                                   atol=1e-4 + 1e-4 * np.abs(l1).max())
+        # the cache the ring prefill wrote must support an exact decode
+        s1 = Sampler(cfg.vocab_size, 0.0, 0.9, 0)
+        s2 = Sampler(cfg.vocab_size, 0.0, 0.9, 0)
+        t1 = [int(s1.sample(e1.decode_one(int(s1.sample(l1[0])))[0][0])) for _ in range(1)]
+        tsp = [int(s2.sample(esp.decode_one(int(s2.sample(lsp[0])))[0][0])) for _ in range(1)]
+        assert t1 == tsp
