@@ -1,0 +1,108 @@
+// Native BPE greedy-merge engine (the tokenizer encode hot loop).
+//
+// Same algorithm as the Python fallback in tokenizer/bpe.py::_merge —
+// lazy max-heap of candidate adjacent pairs over a doubly-linked token
+// list, best score first, earliest position on ties — which reproduces
+// the reference's rescan-per-merge output (tokenizer.cpp:258-287) in
+// O(n log n) instead of O(n²).  A tokenizer handle owns the piece → id
+// hash map (first occurrence wins, matching the reference's bsearch over
+// a vocab sorted with duplicates, tokenizer.cpp:163-168).
+//
+// Build: make -C dllama_tpu/csrc   (libbpe.so; Python falls back when absent)
+
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Tok {
+  std::vector<std::string> vocab;
+  std::vector<float> scores;
+  std::unordered_map<std::string, int32_t> index;
+};
+
+struct Cand {
+  float score;
+  int64_t a, b;       // linked-list slots (original positions)
+  int32_t ia, ib;     // expected token ids at a/b (staleness check)
+  int32_t mid;        // merged id
+};
+
+struct CandLess {  // max-heap: higher score first, then lower position
+  bool operator()(const Cand& x, const Cand& y) const {
+    if (x.score != y.score) return x.score < y.score;
+    return x.a > y.a;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* bpe_create(const uint8_t* blob, const int64_t* offsets,
+                 const float* scores, int64_t n_vocab) {
+  auto* t = new Tok();
+  t->vocab.reserve(n_vocab);
+  t->scores.assign(scores, scores + n_vocab);
+  for (int64_t i = 0; i < n_vocab; ++i) {
+    t->vocab.emplace_back(reinterpret_cast<const char*>(blob + offsets[i]),
+                          static_cast<size_t>(offsets[i + 1] - offsets[i]));
+  }
+  t->index.reserve(static_cast<size_t>(n_vocab) * 2);
+  for (int64_t i = 0; i < n_vocab; ++i) {
+    t->index.emplace(t->vocab[i], static_cast<int32_t>(i));  // first wins
+  }
+  return t;
+}
+
+void bpe_destroy(void* handle) { delete static_cast<Tok*>(handle); }
+
+// In-place greedy merge of tokens[0..n); returns the merged length.
+int64_t bpe_merge(void* handle, int32_t* tokens, int64_t n) {
+  const Tok& t = *static_cast<Tok*>(handle);
+  if (n < 2) return n;
+  std::vector<int32_t> ids(tokens, tokens + n);
+  std::vector<int64_t> nxt(n), prv(n);
+  for (int64_t i = 0; i < n; ++i) {
+    nxt[i] = (i + 1 < n) ? i + 1 : -1;
+    prv[i] = i - 1;
+  }
+  std::vector<uint8_t> alive(n, 1);
+  std::priority_queue<Cand, std::vector<Cand>, CandLess> heap;
+  std::string key;
+
+  auto push = [&](int64_t a, int64_t b) {
+    if (a < 0 || b < 0) return;
+    key.assign(t.vocab[ids[a]]);
+    key += t.vocab[ids[b]];
+    auto it = t.index.find(key);
+    if (it != t.index.end()) {
+      heap.push(Cand{t.scores[it->second], a, b, ids[a], ids[b], it->second});
+    }
+  };
+
+  for (int64_t k = 0; k + 1 < n; ++k) push(k, k + 1);
+  while (!heap.empty()) {
+    Cand c = heap.top();
+    heap.pop();
+    if (!alive[c.a] || !alive[c.b] || nxt[c.a] != c.b ||
+        ids[c.a] != c.ia || ids[c.b] != c.ib) {
+      continue;  // stale
+    }
+    ids[c.a] = c.mid;
+    alive[c.b] = 0;
+    nxt[c.a] = nxt[c.b];
+    if (nxt[c.b] != -1) prv[nxt[c.b]] = c.a;
+    push(prv[c.a], c.a);
+    push(c.a, nxt[c.a]);
+  }
+  int64_t m = 0;
+  for (int64_t k = 0; k != -1; k = nxt[k]) tokens[m++] = ids[k];
+  return m;
+}
+
+}  // extern "C"

@@ -16,29 +16,36 @@ import os
 
 import numpy as np
 
-_LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "csrc", "libq40pack.so")
+_CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
+_LIB_PATH = os.path.join(_CSRC, "libq40pack.so")
+_BPE_PATH = os.path.join(_CSRC, "libbpe.so")
+
+
+def _load_lib(path: str):
+    """Load one csrc shared library, or ``None`` (not built / load failure).
+
+    When the .so is absent (it is machine-specific, never committed) a
+    one-shot build is attempted — a 2 s compile that keeps fresh checkouts
+    on the fast path; any failure falls back to the Python path silently."""
+    if os.environ.get("DLLAMA_NO_NATIVE"):
+        return None
+    if not os.path.exists(path):
+        import subprocess
+        try:
+            subprocess.run(["make", "-C", _CSRC], capture_output=True,
+                           timeout=60, check=False)
+        except Exception:
+            pass
+    try:
+        return ctypes.CDLL(path)
+    except OSError:
+        return None
 
 
 @functools.cache
 def _lib():
-    """The loaded library, or ``None`` (not built / load failure).
-
-    When the .so is absent (it is machine-specific, never committed) a
-    one-shot build is attempted — a 2 s compile that keeps fresh checkouts
-    on the fast path; any failure falls back to numpy silently."""
-    if os.environ.get("DLLAMA_NO_NATIVE"):
-        return None
-    if not os.path.exists(_LIB_PATH):
-        import subprocess
-        try:
-            subprocess.run(["make", "-C", os.path.dirname(_LIB_PATH)],
-                           capture_output=True, timeout=60, check=False)
-        except Exception:
-            pass
-    try:
-        lib = ctypes.CDLL(_LIB_PATH)
-    except OSError:
+    lib = _load_lib(_LIB_PATH)
+    if lib is None:
         return None
     lib.q40_repack.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
@@ -49,6 +56,65 @@ def _lib():
 
 def have_native() -> bool:
     return _lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# BPE merge engine (csrc/bpe.cpp)
+# ---------------------------------------------------------------------------
+
+@functools.cache
+def _bpe_lib():
+    lib = _load_lib(_BPE_PATH)
+    if lib is None:
+        return None
+    lib.bpe_create.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                               ctypes.c_void_p, ctypes.c_int64]
+    lib.bpe_create.restype = ctypes.c_void_p
+    lib.bpe_destroy.argtypes = [ctypes.c_void_p]
+    lib.bpe_merge.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+    lib.bpe_merge.restype = ctypes.c_int64
+    return lib
+
+
+class _BpeHandle:
+    """Owns one native tokenizer handle for a Tokenizer's lifetime."""
+
+    def __init__(self, lib, vocab: list[bytes], scores: list[float]):
+        blob = b"".join(vocab)
+        offsets = np.zeros(len(vocab) + 1, np.int64)
+        np.cumsum([len(v) for v in vocab], out=offsets[1:])
+        self._lib = lib
+        self._blob = np.frombuffer(blob, np.uint8) if blob else np.zeros(0, np.uint8)
+        sc = np.asarray(scores, np.float32)
+        self._ptr = lib.bpe_create(
+            self._blob.ctypes.data_as(ctypes.c_void_p),
+            offsets.ctypes.data_as(ctypes.c_void_p),
+            sc.ctypes.data_as(ctypes.c_void_p), len(vocab))
+
+    def merge(self, tokens: list[int]) -> list[int]:
+        arr = np.asarray(tokens, np.int32)
+        m = self._lib.bpe_merge(self._ptr,
+                                arr.ctypes.data_as(ctypes.c_void_p), len(arr))
+        return arr[:m].tolist()
+
+    def __del__(self):
+        try:
+            self._lib.bpe_destroy(self._ptr)
+        except Exception:
+            pass
+
+
+def bpe_merge(tokenizer, tokens: list[int]) -> list[int] | None:
+    """Native greedy merge for ``tokenizer`` (a Tokenizer), or ``None`` when
+    the library isn't available — the caller then runs the Python heap."""
+    lib = _bpe_lib()
+    if lib is None:
+        return None
+    handle = getattr(tokenizer, "_native_bpe", None)
+    if handle is None:
+        handle = _BpeHandle(lib, tokenizer.vocab, tokenizer.scores)
+        tokenizer._native_bpe = handle
+    return handle.merge(tokens)
 
 
 def q40_repack_into(raw: np.ndarray, d: int, n: int,

@@ -9,12 +9,19 @@ Behavior-compatible with the reference ``Tokenizer``
 * decode: piece lookup, with ``<0xNN>`` raw-byte pieces mapped back to single
   bytes, and the leading space stripped from the piece that follows BOS.
 
-The merge loop here is O(tokens·log) per pass using a dict lookup instead of
-the reference's bsearch-over-sorted-vocab, but produces identical token ids.
+The merge loop produces identical token ids to the reference's
+rescan-per-merge (best score wins, earliest position on ties) but runs in
+O(n log n) via a heap over candidate pairs on a linked list — the
+reference's O(n²) rescan (tokenizer.cpp:258-287) is quadratic in prompt
+length, which matters once ring-prefill makes 100k-token prompts real.
+A native C++ implementation of the same algorithm (csrc/bpe.cpp) is used
+when built; this module's pure-Python version is the fallback and the
+behavioral spec.
 """
 
 from __future__ import annotations
 
+import heapq
 import re
 
 from ..io.tfile import TokenizerData
@@ -73,25 +80,66 @@ class Tokenizer:
                               for b in chunk)
             i = j
 
-        # greedy merge of the best-scoring adjacent pair (tokenizer.cpp:258-287)
-        while True:
-            best_score = -1e10
-            best_id = -1
-            best_idx = -1
-            for k in range(len(tokens) - 1):
-                merged = self.vocab[tokens[k]] + self.vocab[tokens[k + 1]]
-                mid = self._index.get(merged, -1)
-                if mid != -1 and self.scores[mid] > best_score:
-                    best_score = self.scores[mid]
-                    best_id = mid
-                    best_idx = k
-            if best_idx == -1:
-                break
-            tokens[best_idx: best_idx + 2] = [best_id]
+        # greedy merge of the best-scoring adjacent pair (tokenizer.cpp:
+        # 258-287 semantics: global best score per round, earliest position
+        # on ties — realized with a lazy heap over a doubly-linked list
+        # instead of the reference's whole-list rescan per merge)
+        tokens = self._merge(tokens)
 
         if add_eos and self.eos_id >= 0:
             tokens.append(self.eos_id)
         return tokens
+
+    def _merge(self, tokens: list[int]) -> list[int]:
+        """Greedy best-pair merges, reference-identical order."""
+        n = len(tokens)
+        if n < 2:
+            return tokens
+        from ..native import bpe_merge
+
+        merged = bpe_merge(self, tokens)
+        if merged is not None:
+            return merged
+        ids = list(tokens)
+        nxt = list(range(1, n)) + [-1]
+        prv = [-1] + list(range(n - 1))
+        alive = [True] * n
+        index = self._index
+        vocab = self.vocab
+        scores = self.scores
+
+        heap: list[tuple[float, int, int, int, int, int]] = []
+
+        def push(a: int, b: int):
+            if a < 0 or b < 0:
+                return
+            mid = index.get(vocab[ids[a]] + vocab[ids[b]], -1)
+            if mid != -1:
+                # (-score, left position, expected ids, merged id): position
+                # order along the list never changes, so the original index
+                # reproduces the reference's earliest-index tie-break
+                heapq.heappush(heap, (-scores[mid], a, ids[a], ids[b], b, mid))
+
+        for k in range(n - 1):
+            push(k, k + 1)
+        while heap:
+            _, a, ia, ib, b, mid = heapq.heappop(heap)
+            if not (alive[a] and alive[b] and nxt[a] == b
+                    and ids[a] == ia and ids[b] == ib):
+                continue  # stale candidate
+            ids[a] = mid
+            alive[b] = False
+            nxt[a] = nxt[b]
+            if nxt[b] != -1:
+                prv[nxt[b]] = a
+            push(prv[a], a)
+            push(a, nxt[a])
+        out = []
+        k = 0
+        while k != -1:
+            out.append(ids[k])
+            k = nxt[k]
+        return out
 
     def decode_piece(self, prev_token: int, token: int) -> bytes:
         """One token → bytes (tokenizer.cpp:150-161)."""
