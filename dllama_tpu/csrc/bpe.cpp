@@ -80,7 +80,9 @@ int64_t bpe_merge(void* handle, int32_t* tokens, int64_t n) {
     key.assign(t.vocab[ids[a]]);
     key += t.vocab[ids[b]];
     auto it = t.index.find(key);
-    if (it != t.index.end()) {
+    // strict > -1e10 keeps reference parity for sentinel/-inf/NaN scores
+    // (its best_score starts at -1e10, tokenizer.cpp:262)
+    if (it != t.index.end() && t.scores[it->second] > -1e10f) {
       heap.push(Cand{t.scores[it->second], a, b, ids[a], ids[b], it->second});
     }
   };

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from ..ops import q40
 from ..ops.attention import gqa_attention, update_kv_cache
 from ..ops.kernels import ACTIVATIONS, apply_rope, rmsnorm, rope_angles, softmax_f32
-from ..ops.sp_attention import ring_attention, sp_gqa_attention
+from ..ops.sp_attention import ring_attention, sp_gqa_attention, sp_update_kv_cache
 from ..parallel.mesh import get_active_mesh
 from .config import ModelConfig
 from .params import Params
@@ -88,9 +88,13 @@ def _attention_block(x, lp, cfg: ModelConfig, k_cache, v_cache, cos, sin, pos):
     q = q.transpose(0, 2, 1, 3)  # (B, Hq, T, Dh)
     k = k.transpose(0, 2, 1, 3)
     v = v.transpose(0, 2, 1, 3)
-    k_cache, v_cache = update_kv_cache(k_cache, v_cache, k, v, pos)
-
     mesh = get_active_mesh()
+    if t == 1 and mesh is not None and mesh.shape.get("sp", 1) > 1:
+        # seq-sharded cache: explicit shard-local write (no GSPMD-chosen
+        # gather/scatter per decode step)
+        k_cache, v_cache = sp_update_kv_cache(k_cache, v_cache, k, v, pos, mesh)
+    else:
+        k_cache, v_cache = update_kv_cache(k_cache, v_cache, k, v, pos)
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
         if cfg.ring_prefill and t > 1:
             # from-scratch prefill: the fresh block IS the whole history

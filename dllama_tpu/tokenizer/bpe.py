@@ -114,7 +114,9 @@ class Tokenizer:
             if a < 0 or b < 0:
                 return
             mid = index.get(vocab[ids[a]] + vocab[ids[b]], -1)
-            if mid != -1:
+            # the strict > -1e10 keeps reference parity for sentinel/-inf
+            # scores (its best_score starts at -1e10, tokenizer.cpp:262)
+            if mid != -1 and scores[mid] > -1e10:
                 # (-score, left position, expected ids, merged id): position
                 # order along the list never changes, so the original index
                 # reproduces the reference's earliest-index tie-break

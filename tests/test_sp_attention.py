@@ -66,6 +66,26 @@ class TestOp:
                                    rtol=1e-5, atol=1e-5)
 
 
+class TestSpCacheUpdate:
+    @needs_8
+    @pytest.mark.parametrize("pos", [0, 7, 8, 31])
+    def test_shard_local_write_equals_plain_update(self, pos):
+        from dllama_tpu.ops.attention import update_kv_cache
+        from dllama_tpu.ops.sp_attention import sp_update_kv_cache
+
+        mesh = make_mesh(tp=2, sp=4, dp=1, devices=jax.devices()[:8])
+        r = np.random.RandomState(pos)
+        kc = jnp.asarray(r.randn(1, 2, 32, 8), jnp.float32)
+        vc = jnp.asarray(r.randn(1, 2, 32, 8), jnp.float32)
+        kn = jnp.asarray(r.randn(1, 2, 1, 8), jnp.float32)
+        vn = jnp.asarray(r.randn(1, 2, 1, 8), jnp.float32)
+        ek, ev = update_kv_cache(kc, vc, kn, vn, jnp.int32(pos))
+        gk, gv = jax.jit(lambda *a: sp_update_kv_cache(*a, jnp.int32(pos), mesh))(
+            kc, vc, kn, vn)
+        np.testing.assert_array_equal(np.asarray(gk), np.asarray(ek))
+        np.testing.assert_array_equal(np.asarray(gv), np.asarray(ev))
+
+
 class TestRing:
     """ring_attention: sharded-Q prefill over rotating KV blocks must equal
     dense causal attention (the same invariance pattern, now with queries
