@@ -72,6 +72,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="data-parallel degree: batches dp identical streams "
                         "over a dp mesh axis (beyond-reference capability; "
                         "only stream 0 is printed)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel degree for MoE models: dense expert "
+                        "stacks shard over experts instead of replicating "
+                        "(beyond-reference; the reference TP-slices all "
+                        "experts everywhere, transformer.cpp:299-317)")
     p.add_argument("--coordinator", default=None,
                    help="multi-host: process-0 host:port for "
                         "jax.distributed.initialize (parallel/distributed.py); "
@@ -122,7 +127,7 @@ def load_stack(args) -> tuple[Engine, Tokenizer]:
     print(f"💡 arch: {mf.spec.arch_name}")
     print(f"💡 dim: {cfg.dim}\n💡 nLayers: {cfg.n_layers}\n💡 nHeads: {cfg.n_heads}")
     print(f"💡 nKvHeads: {cfg.n_kv_heads}\n💡 vocabSize: {cfg.vocab_size}\n💡 seqLen: {cfg.seq_len}")
-    mesh = parse_workers(args.workers, sp=args.sp, dp=args.dp)
+    mesh = parse_workers(args.workers, sp=args.sp, dp=args.dp, ep=args.ep)
     axes = {k: v for k, v in mesh.shape.items() if v > 1} or {"tp": 1}
     print("💡 mesh: " + " ".join(f"{k}={v}" for k, v in axes.items()))
     # fused qkv/w13 is the single-chip fast layout; under tp>1 the unfused

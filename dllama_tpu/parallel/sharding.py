@@ -71,9 +71,13 @@ def param_specs(cfg: ModelConfig) -> dict[str, P]:
     if cfg.is_moe:
         specs.update({
             "router": REPL,                  # root-computed in the reference (grok1-tasks.cpp:59)
-            "up": P(None, None, None, "tp"),    # dense-TP MoE: every expert on every
-            "gate": P(None, None, None, "tp"),  # shard, hidden dim sliced
-            "down": P(None, None, "tp", None),  # (transformer.cpp:299-317)
+            # dense-TP MoE: hidden dim sliced on tp (transformer.cpp:
+            # 299-317); the expert axis additionally shards over ep — a
+            # no-op on the default ep=1 mesh, the beyond-reference
+            # expert-parallel layout when ep>1
+            "up": P(None, "ep", None, "tp"),
+            "gate": P(None, "ep", None, "tp"),
+            "down": P(None, "ep", "tp", None),
         })
         if cfg.post_block_norms:
             specs.update({"rms_moe": REPL, "rms_ffn2": REPL})
@@ -119,15 +123,25 @@ def place_params(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
     specs = param_specs(cfg)
     out = {}
     for k, v in params.items():
-        if not _spec_divides(v, specs[k], mesh):
+        spec = specs[k]
+        if (k in ("up", "gate", "down") and mesh.shape.get("ep", 1) > 1
+                and hasattr(v, "qpacked")):
+            # packed-Q40 expert stacks stay expert-replicated: the fused
+            # kernel's scalar-prefetch expert select indexes the full local
+            # stack (ops/q40.py QLayerView); expert-parallel packed MoE
+            # would need a cross-shard select and is not worth the ICI
+            # round at current expert sizes
+            print(f"⚠️  sharding: {k} is packed Q40 — expert axis kept "
+                  "replicated (ep applies to dense expert stacks)")
+            spec = P(*[None if ax == "ep" else ax for ax in spec])
+        if not _spec_divides(v, spec, mesh):
             # e.g. a Q40 scales plane (n/32 rows) that doesn't divide the
             # mesh axis: keep the tensor replicated — q40.matmul makes the
             # matching per-tensor fallback (_tp_shardable) at trace time
             print(f"⚠️  sharding: {k} {jax.tree.leaves(v)[0].shape} does not "
                   f"divide mesh {dict(mesh.shape)} evenly; replicating")
-            out[k] = jax.device_put(v, NamedSharding(mesh, REPL))
-        else:
-            out[k] = jax.device_put(v, NamedSharding(mesh, specs[k]))
+            spec = REPL
+        out[k] = jax.device_put(v, NamedSharding(mesh, spec))
     return out
 
 

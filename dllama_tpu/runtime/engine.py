@@ -144,6 +144,13 @@ class Engine:
         self.sp = self.mesh.shape.get("sp", 1)
         if self.sp > 1 and self.seq_len % self.sp:
             raise ValueError(f"seq_len {self.seq_len} not divisible by sp={self.sp}")
+        ep = self.mesh.shape.get("ep", 1)
+        if ep > 1:
+            if not cfg.is_moe:
+                raise ValueError("ep>1 needs an MoE model (no expert axis to shard)")
+            if cfg.n_experts % ep:
+                raise ValueError(
+                    f"n_experts {cfg.n_experts} not divisible by ep={ep}")
         self.cfg = cfg
         self.params = sharding.place_params(params, cfg, self.mesh)
         # sp>1 shards the cache's sequence axis: max context scales with
