@@ -156,12 +156,14 @@ def _run_cli_bench(name, steps=320, chunk=32):
            # timing starts
            str(steps), "--chunk", str(chunk), "--warmup", str(steps),
            "--temperature", "0", "--seed", "0"]
-    env = dict(os.environ)
-    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    # the grandchild's timeout comes from an absolute deadline so model
+    # synthesis time above cannot push the kill past the attempt timeout
+    # (which would orphan the CLI process on the TPU)
+    deadline = float(os.environ.get("BENCH_CLI_DEADLINE", time.time() + 780))
     try:
         r = subprocess.run(cmd, cwd=here, stdout=subprocess.PIPE, text=True,
-                           env=env,
-                           timeout=float(os.environ.get("BENCH_CLI_TIMEOUT_S", "780")))
+                           env=_child_env(),
+                           timeout=max(deadline - time.time(), 60))
     except subprocess.TimeoutExpired:
         raise RuntimeError("CLI bench timed out (child killed)")
     sys.stderr.write("\n".join(r.stdout.splitlines()[-8:]) + "\n")
@@ -171,6 +173,73 @@ def _run_cli_bench(name, steps=320, chunk=32):
     if not m:
         raise RuntimeError("CLI bench output had no 'Avg generation time'")
     return float(m.group(1))
+
+
+def _child_env(extra: dict | None = None) -> dict:
+    """Subprocess env with the repo importable (shared by every stage that
+    launches a helper script)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.update(extra or {})
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _variant_sweep(budget_s: float) -> str:
+    """Mini-sweep on hardware: time each kernel dequant variant on the 7B
+    stacked shapes (tools/sweep_q40.measure_one, fresh subprocess per
+    variant) and return the fastest; 'classic' on any failure.  The chosen
+    variant configures the subsequent bench stages via DLLAMA_Q40_VARIANT —
+    evidence lands in the driver log (VERDICT r02 Next #2)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    t0 = time.time()
+    results = []
+    for variant in ("classic", "folded", "exact"):
+        left = budget_s - (time.time() - t0)
+        if left < 60:
+            print(f"bench: sweep budget exhausted before {variant}", file=sys.stderr)
+            break
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.join(here, "tools", "sweep_q40.py"),
+                 "--one", variant],
+                stdout=subprocess.PIPE, env=_child_env(), cwd=here,
+                timeout=min(left, 240))
+            out = json.loads(r.stdout.decode().strip().splitlines()[-1])
+            ms = out["proj_matmul_ms_per_token"]
+            results.append((ms, variant))
+            print(f"bench: sweep {variant}: {ms:.2f} ms/token matmuls "
+                  f"@ {out['proj_matmul_GBps']:.0f} GB/s", file=sys.stderr)
+        except Exception as e:
+            print(f"bench: sweep {variant} failed ({type(e).__name__}: "
+                  f"{str(e)[:120]})", file=sys.stderr)
+    if not results:
+        return "classic"
+    results.sort()
+    print(f"bench: sweep winner: {results[0][1]}", file=sys.stderr)
+    return results[0][1]
+
+
+def _profile_split_stderr(run_once, chunk):
+    """Trace one decode chunk and log the compute/collective split — the
+    reference's I/T attribution on a real TPU xplane (VERDICT r02 Next #4)."""
+    try:
+        from dllama_tpu.runtime.profiling import profiled_split
+
+        split = profiled_split(run_once, steps=1)
+        if split is None:
+            print("bench: profile split unavailable (no xplane tooling/trace)",
+                  file=sys.stderr)
+            return
+        comp, coll = split["compute_ms"], split["collective_ms"]
+        verdict = ("T≈0 contract holds" if coll < 1.0
+                   else f"collectives are {split['collective_pct']:.1f}% — inspect")
+        print(f"bench: profile split over {chunk}-token chunk: "
+              f"compute {comp:.1f} ms, collectives {coll:.1f} ms "
+              f"({comp / chunk:.2f} ms/token compute; {verdict})", file=sys.stderr)
+    except Exception as e:
+        print(f"bench: profile split failed ({type(e).__name__}: {str(e)[:120]})",
+              file=sys.stderr)
 
 
 def _pallas_hw_check():
@@ -202,7 +271,7 @@ def _pallas_hw_check():
         return "xla"
 
 
-def _bench_decode(cfg, chunk=32, n_chunks=3):
+def _bench_decode(cfg, chunk=32, n_chunks=10, profile=False):
     """Greedy on-device decode loop; returns avg ms/token over the timed
     chunks (compile + warmup excluded)."""
     import jax
@@ -232,6 +301,17 @@ def _bench_decode(cfg, chunk=32, n_chunks=3):
         toks, cache, tok, _, _ = fn(params, cache, tok, jnp.int32((i + 1) * chunk), key)
         np.asarray(toks)  # forces execution; only K int32 ids cross the boundary
         times.append((time.perf_counter() - t0) * 1000 / chunk)
+
+    if profile:
+        state = {"cache": cache, "tok": tok}
+
+        def run_once():
+            toks, state["cache"], state["tok"], _, _ = fn(
+                params, state["cache"], state["tok"],
+                jnp.int32((n_chunks + 1) * chunk), key)
+            np.asarray(toks)
+
+        _profile_split_stderr(run_once, chunk)
     return float(np.mean(times))
 
 
@@ -260,9 +340,10 @@ def run_attempt(name):
         impl, chunk, n_chunks = "xla", 16, 2
     else:
         impl = _pallas_hw_check()
-        chunk, n_chunks = 32, 3
+        chunk, n_chunks = 32, 10  # ≥10 timed chunks (ADVICE r02)
     cfg = cfg.with_(quant_impl=impl)
-    ms = _bench_decode(cfg, chunk=chunk, n_chunks=n_chunks)
+    ms = _bench_decode(cfg, chunk=chunk, n_chunks=n_chunks,
+                       profile=(name == "llama2-7b"))
     toks = 1000.0 / ms
     backend = jax.default_backend()
     if name == "llama2-7b":
@@ -328,14 +409,21 @@ def main():
     probe = _spawn("probe", min(PROBE_TIMEOUT_S, max(remaining() - 420, 60)))
     on_hw = probe is not None and probe.get("platform") != "cpu"
 
+    hw_env = {}
     if on_hw:
+        # pick the fastest kernel variant on this hardware first (bounded);
+        # everything after runs with it
+        if remaining() > 1000:
+            variant = _variant_sweep(min(remaining() - 800, 420))
+            if variant != "classic":
+                hw_env["DLLAMA_Q40_VARIANT"] = variant
         chunk_out = None
         for name in ("llama2-7b", "tinyllama-1.1b"):
             budget = remaining() - 360  # keep room for the CPU fallback
             if budget < 180:
                 print("bench: budget exhausted, skipping to fallback", file=sys.stderr)
                 break
-            chunk_out = _spawn(name, min(budget, 900))
+            chunk_out = _spawn(name, min(budget, 900), env_extra=hw_env)
             if chunk_out:
                 break
         # the operator-surface run (synth .m → loader → Engine → CLI stats)
@@ -343,14 +431,39 @@ def main():
         # the decode_chunk number above remains the recorded cross-check.
         # Only attempted when the 7B shape itself just worked — a tinyllama
         # fallback means 7B failed and re-running it would burn the budget.
+        cli_out = None
         if chunk_out and "llama2-7b" in chunk_out.get("metric", "") \
                 and remaining() > 480:
-            cli_out = _spawn("llama2-7b-cli", remaining() - 150)
-            if cli_out:
-                print(f"bench: decode_chunk cross-check: {json.dumps(chunk_out)}",
+            # the grandchild CLI process is killed at an absolute deadline
+            # strictly inside the attempt timeout, so a hang can never
+            # orphan it on the TPU (synthesis time is inside the deadline)
+            cli_env = dict(hw_env)
+            cli_env["BENCH_CLI_DEADLINE"] = str(time.time() + remaining() - 240)
+            cli_out = _spawn("llama2-7b-cli", remaining() - 150, env_extra=cli_env)
+        # packed-MoE decode on hardware once (VERDICT r02 Next #5): the
+        # QLayerView scalar-prefetch expert select must lower under Mosaic.
+        # Runs after the headline stages so a hang here costs diagnostics,
+        # not the number.
+        if chunk_out and remaining() > 300:
+            here = os.path.dirname(os.path.abspath(__file__))
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.join(here, "tools", "moe_hw_check.py"),
+                     "--layers", "2", "--steps", "8"],
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    env=_child_env(hw_env), cwd=here,
+                    timeout=min(remaining() - 60, 240))
+                tail = r.stdout.decode().strip().splitlines()[-1] if r.stdout else ""
+                print(f"bench: moe hw check rc={r.returncode}: {tail}",
                       file=sys.stderr)
-                _emit(cli_out)
-                return
+            except Exception as e:
+                print(f"bench: moe hw check failed ({type(e).__name__})",
+                      file=sys.stderr)
+        if cli_out:
+            print(f"bench: decode_chunk cross-check: {json.dumps(chunk_out)}",
+                  file=sys.stderr)
+            _emit(cli_out)
+            return
         if chunk_out:
             _emit(chunk_out)
             return
