@@ -1,0 +1,24 @@
+#!/bin/sh
+# Multi-host pod-slice launcher — the reference's examples/n-workers.sh
+# analogue (it screen-spawns N TCP workers on one box; here every host
+# joins one JAX process group and the mesh spans all chips — see
+# docs/MULTIHOST.md).
+#
+# Run ON EVERY HOST of the slice (host 0 first; it serves coordination):
+#   HOSTS=4 PROC_ID=$k COORD=host0:8476 MODEL=... TOKENIZER=... \
+#     sh examples/pod-slice.sh "your prompt"
+set -e
+COORD="${COORD:?set COORD=host0:port (process 0's address)}"
+HOSTS="${HOSTS:?set HOSTS=<number of hosts>}"
+PROC_ID="${PROC_ID:?set PROC_ID=<this host's index, 0-based>}"
+MODEL="${MODEL:?set MODEL=/path/to/model.m}"
+TOKENIZER="${TOKENIZER:?set TOKENIZER=/path/to/tokenizer.t}"
+PROMPT="${1:-Hello}"
+STEPS="${STEPS:-64}"
+WORKERS="${WORKERS:-}"   # e.g. tpu:16; empty = all chips in the slice
+
+exec python -m dllama_tpu worker --program generate \
+  --coordinator "$COORD" --nproc "$HOSTS" --proc-id "$PROC_ID" \
+  --model "$MODEL" --tokenizer "$TOKENIZER" \
+  --prompt "$PROMPT" --steps "$STEPS" --temperature 0 \
+  ${WORKERS:+--workers "$WORKERS"}
