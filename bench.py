@@ -69,20 +69,29 @@ def _model_cfg(name):
 
 def _zero_q40_params(cfg):
     """Params with packed-Q40 matmul weights, built as zero device buffers
-    (no host-side f32 materialization)."""
+    (no host-side f32 materialization).  Matches the quantized loader's
+    single-chip layout (load_params fuse=True): fused wqkv everywhere,
+    fused w13 for dense FFNs, packed expert stacks for MoE — shared by
+    the bench and tools/moe_hw_check.py."""
     import jax.numpy as jnp
     from dllama_tpu.models.params import param_shapes
     from dllama_tpu.ops.q40 import QTensor, padded_n
 
     shapes = dict(param_shapes(cfg))
     L, D = cfg.n_layers, cfg.dim
-    # fused projection layout, as the quantized loader produces
+    # fused wqkv, as the quantized loader produces (load_params fuse=True)
     shapes["wqkv"] = (L, D, (cfg.n_heads + 2 * cfg.n_kv_heads) * cfg.head_size)
-    shapes["w13"] = (L, D, 2 * cfg.hidden_dim)
-    for k in ("wq", "wk", "wv", "w1", "w3"):
+    for k in ("wq", "wk", "wv"):
         del shapes[k]
+    qkeys = {"wqkv", "wo", "wcls"}
+    if cfg.is_moe:
+        qkeys |= {"up", "gate", "down"}
+    else:
+        shapes["w13"] = (L, D, 2 * cfg.hidden_dim)
+        for k in ("w1", "w3"):
+            del shapes[k]
+        qkeys |= {"w13", "w2"}
 
-    qkeys = {"wqkv", "wo", "w13", "w2", "wcls"}
     params = {}
     for k, shape in shapes.items():
         if k in qkeys:

@@ -80,14 +80,15 @@ class _BpeHandle:
     """Owns one native tokenizer handle for a Tokenizer's lifetime."""
 
     def __init__(self, lib, vocab: list[bytes], scores: list[float]):
-        blob = b"".join(vocab)
+        blob = np.frombuffer(b"".join(vocab) or b"\0", np.uint8)
         offsets = np.zeros(len(vocab) + 1, np.int64)
         np.cumsum([len(v) for v in vocab], out=offsets[1:])
         self._lib = lib
-        self._blob = np.frombuffer(blob, np.uint8) if blob else np.zeros(0, np.uint8)
         sc = np.asarray(scores, np.float32)
+        # bpe_create copies everything into C++-owned storage, so no
+        # host-side buffer needs to outlive this call
         self._ptr = lib.bpe_create(
-            self._blob.ctypes.data_as(ctypes.c_void_p),
+            blob.ctypes.data_as(ctypes.c_void_p),
             offsets.ctypes.data_as(ctypes.c_void_p),
             sc.ctypes.data_as(ctypes.c_void_p), len(vocab))
 

@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
 from ..models.params import Params
@@ -192,8 +193,8 @@ class Engine:
                     and tokens_np.shape[1] % self.sp == 0)
         with active_mesh(self.mesh):  # read at trace time (first call)
             if use_ring:
-                from jax.sharding import NamedSharding, PartitionSpec as P
-                toks = jax.device_put(tokens_np, NamedSharding(self.mesh, P("dp", "sp")))
+                toks = jax.device_put(
+                    tokens_np, NamedSharding(self.mesh, P("dp", "sp")))
                 logits, self.cache = self._step_ring(
                     self.params, self.cache, toks,
                     jnp.int32(self.pos), jnp.int32(last_index))

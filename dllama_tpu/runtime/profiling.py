@@ -31,15 +31,15 @@ _COLLECTIVE = re.compile(
 _HLO_NAME = re.compile(r"^[a-z][a-z0-9_.\-]*$")
 
 
-def _parse_xspace(path: str) -> tuple[float, float]:
-    """Returns (compute_ms, collective_ms) summed over all device planes."""
+def _iter_op_events(path: str):
+    """Yield (hlo_op_name, duration_ps) from every device plane of one
+    xplane file — the shared walk under both the compute/collective split
+    and per-op attribution (tools/profile_decode.py)."""
     from tensorflow.tsl.profiler.protobuf import xplane_pb2  # lazy, heavy
 
     xs = xplane_pb2.XSpace()
     with open(path, "rb") as f:
         xs.ParseFromString(f.read())
-    compute_ps = 0
-    collective_ps = 0
     for plane in xs.planes:
         # TPU op time lives in '/device:TPU:N' planes; the CPU backend logs
         # ops into '/host:CPU'.  Skip pure-metadata planes.
@@ -49,12 +49,28 @@ def _parse_xspace(path: str) -> tuple[float, float]:
         for line in plane.lines:
             for ev in line.events:
                 name = md.get(ev.metadata_id, "")
-                if not _HLO_NAME.match(name):
-                    continue
-                if _COLLECTIVE.search(name):
-                    collective_ps += ev.duration_ps
-                else:
-                    compute_ps += ev.duration_ps
+                if _HLO_NAME.match(name):
+                    yield name, ev.duration_ps
+
+
+def op_times(trace_dir: str) -> dict[str, float]:
+    """Sum device-plane op durations (ms) by op name over a trace dir."""
+    times: dict[str, float] = {}
+    for path in glob.glob(trace_dir + "/**/*.xplane.pb", recursive=True):
+        for name, ps in _iter_op_events(path):
+            times[name] = times.get(name, 0.0) + ps / 1e9
+    return times
+
+
+def _parse_xspace(path: str) -> tuple[float, float]:
+    """Returns (compute_ms, collective_ms) summed over all device planes."""
+    compute_ps = 0
+    collective_ps = 0
+    for name, ps in _iter_op_events(path):
+        if _COLLECTIVE.search(name):
+            collective_ps += ps
+        else:
+            compute_ps += ps
     return compute_ps / 1e9, collective_ps / 1e9
 
 

@@ -30,10 +30,9 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
+    from bench import _zero_q40_params
     from dllama_tpu.models.config import tiny_config
     from dllama_tpu.models.transformer import init_kv_cache
-    from dllama_tpu.ops.q40 import QTensor, padded_n
-    from dllama_tpu.models.params import param_shapes
     from dllama_tpu.runtime.decode_loop import decode_chunk
 
     print(f"backend: {jax.default_backend()} {jax.devices()}", file=sys.stderr)
@@ -48,16 +47,7 @@ def main():
                       dtype=jnp.bfloat16,
                       ).with_(quant_impl="pallas" if on_tpu else "pallas_interpret")
 
-    shapes = param_shapes(cfg)
-    params = {}
-    for k, s in shapes.items():
-        if k in ("up", "gate", "down", "wq", "wk", "wv", "wo", "wcls"):
-            *lead, n, d = s
-            np_ = padded_n(n)
-            params[k] = QTensor(jnp.zeros((*lead, np_ // 2, d), jnp.uint8),
-                                jnp.zeros((*lead, np_ // 32, d), jnp.float16), (n, d))
-        else:
-            params[k] = jnp.zeros(s, jnp.float32 if k.startswith("rms") else cfg.dtype)
+    params = _zero_q40_params(cfg)
     cache = init_kv_cache(cfg, batch=1)
 
     fn = jax.jit(

@@ -12,38 +12,12 @@ Usage: python tools/profile_decode.py [model] [--top N]
 from __future__ import annotations
 
 import argparse
-import glob
 import os
-import re
 import sys
 import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-_HLO_NAME = re.compile(r"^[a-z][a-z0-9_.\-]*$")
-
-
-def collect_op_times(trace_dir: str) -> dict[str, float]:
-    """Sum device-plane event durations (ms) by op name."""
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
-
-    times: dict[str, float] = {}
-    for path in glob.glob(trace_dir + "/**/*.xplane.pb", recursive=True):
-        xs = xplane_pb2.XSpace()
-        with open(path, "rb") as f:
-            xs.ParseFromString(f.read())
-        for plane in xs.planes:
-            if not (plane.name.startswith("/device:") or plane.name == "/host:CPU"):
-                continue
-            md = {m.id: m.name for m in plane.event_metadata.values()}
-            for line in plane.lines:
-                for ev in line.events:
-                    name = md.get(ev.metadata_id, "")
-                    if not _HLO_NAME.match(name):
-                        continue
-                    times[name] = times.get(name, 0.0) + ev.duration_ps / 1e9
-    return times
 
 
 def main():
@@ -62,7 +36,8 @@ def main():
     from dllama_tpu.runtime.decode_loop import decode_chunk
 
     print(f"backend: {jax.default_backend()} {jax.devices()}", file=sys.stderr)
-    cfg = _model_cfg(args.model).with_(quant_impl="pallas")
+    impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    cfg = _model_cfg(args.model).with_(quant_impl=impl)
     params = _zero_q40_params(cfg)
     cache = init_kv_cache(cfg, batch=1)
     chunk = args.chunk
@@ -91,7 +66,8 @@ def main():
         toks, cache, tok, _, _ = fn(params, cache, tok, jnp.int32(2 * chunk), key)
         np.asarray(toks)
         jax.profiler.stop_trace()
-        times = collect_op_times(d)
+        from dllama_tpu.runtime.profiling import op_times
+        times = op_times(d)
 
     total = sum(times.values())
     print(f"\ndevice op time: {total:.1f} ms over {chunk} steps "
