@@ -53,6 +53,27 @@ def _kv_chunk(s: int) -> int:
     return s
 
 
+def _online_fold(qf, kb, vb, mask, m, l, acc, scale):
+    """One flash-softmax block fold shared by the blocked prefill scan and
+    the length-aware decode loop: fold block scores masked by ``mask``
+    (broadcast over (B, Hkv, G)) into the running (max, denom, numerator)."""
+    scores = jnp.einsum("bhgtd,bhsd->bhgts", qf, kb.astype(jnp.float32)) * scale
+    scores = jnp.where(mask[None, None, None], scores, _NEG)
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    l_new = alpha * l + p.sum(axis=-1)
+    acc_new = alpha[..., None] * acc + jnp.einsum(
+        "bhgts,bhsd->bhgtd", p, vb.astype(jnp.float32))
+    return m_new, l_new, acc_new
+
+
+def _fold_init(b, hkv, g, t, dh):
+    return (jnp.full((b, hkv, g, t), _NEG),
+            jnp.zeros((b, hkv, g, t), jnp.float32),
+            jnp.zeros((b, hkv, g, t, dh), jnp.float32))
+
+
 def blocked_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                           pos: jax.Array, q_len: int) -> jax.Array:
     """Flash-style causal GQA: ``lax.scan`` over KV chunks with an online
@@ -70,30 +91,67 @@ def blocked_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 
     qf = q.astype(jnp.float32).reshape(b, hkv, g, t, dh)
     # chunk-major scan inputs: (nc, B, Hkv, c, Dh)
-    kc = k_cache.astype(jnp.float32).reshape(b, hkv, nc, c, dh).transpose(2, 0, 1, 3, 4)
-    vc = v_cache.astype(jnp.float32).reshape(b, hkv, nc, c, dh).transpose(2, 0, 1, 3, 4)
+    kc = k_cache.reshape(b, hkv, nc, c, dh).transpose(2, 0, 1, 3, 4)
+    vc = v_cache.reshape(b, hkv, nc, c, dh).transpose(2, 0, 1, 3, 4)
     t_idx = pos + jnp.arange(t)[:, None]  # (T, 1)
 
     def body(carry, inp):
-        m, l, acc = carry
         kb, vb, base = inp
-        scores = jnp.einsum("bhgtd,bhsd->bhgts", qf, kb) * scale  # (B,Hkv,G,T,c)
-        s_idx = base + jnp.arange(c)[None, :]
-        mask = s_idx <= t_idx  # (T, c)
-        scores = jnp.where(mask[None, None, None], scores, _NEG)
-        m_new = jnp.maximum(m, scores.max(axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(scores - m_new[..., None])
-        l_new = alpha * l + p.sum(axis=-1)
-        acc_new = alpha[..., None] * acc + jnp.einsum("bhgts,bhsd->bhgtd", p, vb)
-        return (m_new, l_new, acc_new), None
+        mask = (base + jnp.arange(c)[None, :]) <= t_idx  # (T, c)
+        return _online_fold(qf, kb, vb, mask, *carry, scale), None
 
-    init = (jnp.full((b, hkv, g, t), _NEG),
-            jnp.zeros((b, hkv, g, t), jnp.float32),
-            jnp.zeros((b, hkv, g, t, dh), jnp.float32))
     bases = jnp.arange(nc) * c
-    (m, l, acc), _ = jax.lax.scan(body, init, (kc, vc, bases))
-    out = acc / l[..., None]
+    (m, l, acc), _ = jax.lax.scan(body, _fold_init(b, hkv, g, t, dh),
+                                  (kc, vc, bases))
+    out = acc / jnp.maximum(l, 1e-38)[..., None]
+    return out.reshape(b, hq, t, dh).astype(q.dtype)
+
+
+# Decode (t==1) over caches at least this long walks only the live
+# prefix of the cache (length-aware while_loop) instead of reading the
+# whole preallocated buffer; below it, one-shot attention is cheaper than
+# the loop overhead.
+_DECODE_BLOCKED_MIN_S = 4096
+
+
+def decode_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                         pos: jax.Array) -> jax.Array:
+    """Single-token causal GQA that reads only blocks covering positions
+    ``0..pos``.
+
+    A static-shape einsum over the full cache costs O(S) HBM traffic per
+    token no matter where in the sequence decoding stands — at 64k
+    context that is ~32 GB/token for 7B shapes, dwarfing the weights.
+    The reference's attention loop is O(pos) (llama2-tasks.cpp:68-92);
+    this restores that bound under XLA's static shapes with a
+    ``lax.while_loop`` whose trip count is ``pos//block + 1``: each step
+    dynamic-slices one KV block and folds it into the online-softmax
+    accumulator, so traffic is proportional to the live prefix.
+    """
+    b, hq, t, dh = q.shape
+    hkv = k_cache.shape[1]
+    s = k_cache.shape[2]
+    g = hq // hkv
+    block = _kv_chunk(s)
+    scale = 1.0 / jnp.sqrt(jnp.float32(dh))
+    qf = q.astype(jnp.float32).reshape(b, hkv, g, t, dh)
+    n_live = pos // block + 1
+
+    def cond(c):
+        return c[0] < n_live
+
+    def body(c):
+        i, m, l, acc = c
+        start = i * block
+        kb = jax.lax.dynamic_slice_in_dim(k_cache, start, block, axis=2)
+        vb = jax.lax.dynamic_slice_in_dim(v_cache, start, block, axis=2)
+        mask = ((start + jnp.arange(block)) <= pos)[None, :]  # (1=T, block)
+        m, l, acc = _online_fold(qf, kb, vb, mask, m, l, acc, scale)
+        return i + 1, m, l, acc
+
+    _, _, l, acc = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), *_fold_init(b, hkv, g, t, dh)))
+    out = acc / jnp.maximum(l, 1e-38)[..., None]
     return out.reshape(b, hq, t, dh).astype(q.dtype)
 
 
@@ -112,7 +170,9 @@ def gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     (B, Hkv, G, T, Dh) so each kv head serves G query heads in one einsum.
 
     Long prefills (score tensor past ``_BLOCKED_THRESHOLD`` elements per
-    batch×kv-head) dispatch to :func:`blocked_gqa_attention`.
+    batch×kv-head) dispatch to :func:`blocked_gqa_attention`; decode over
+    a long cache dispatches to the length-aware
+    :func:`decode_gqa_attention`.
     """
     b, hq, t, dh = q.shape
     hkv = k_cache.shape[1]
@@ -121,6 +181,10 @@ def gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 
     if t > 1 and g * t * s > _BLOCKED_THRESHOLD:
         return blocked_gqa_attention(q, k_cache, v_cache, pos, q_len)
+    if t == 1 and s >= _DECODE_BLOCKED_MIN_S and _kv_chunk(s) < s:
+        # _kv_chunk(s) == s would be one loop step over the whole cache:
+        # all the loop overhead, none of the O(pos) traffic win
+        return decode_gqa_attention(q, k_cache, v_cache, pos)
 
     qf = q.astype(jnp.float32).reshape(b, hkv, g, t, dh)
     kf = k_cache.astype(jnp.float32)

@@ -87,3 +87,38 @@ def test_update_then_attend_roundtrip():
     out2 = blocked_gqa_attention(q, kc, vc, jnp.int32(0), 4)
     np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_decode_blocked_matches_one_shot(monkeypatch):
+    """The length-aware decode path (while_loop over live KV blocks) must
+    equal full-cache one-shot attention at every position class."""
+    from dllama_tpu.ops import attention
+    from dllama_tpu.ops.attention import decode_gqa_attention
+
+    r = np.random.RandomState(0)
+    b, hq, hkv, s, dh = 1, 4, 2, 8192, 8
+    q = jnp.asarray(r.randn(b, hq, 1, dh), jnp.float32)
+    k = jnp.asarray(r.randn(b, hkv, s, dh), jnp.float32)
+    v = jnp.asarray(r.randn(b, hkv, s, dh), jnp.float32)
+    fn = jax.jit(decode_gqa_attention)
+    for pos in (0, 1, 1023, 1024, 5000, s - 1):
+        got = fn(q, k, v, jnp.int32(pos))
+        # the reference must be the genuine one-shot full-cache path, not a
+        # re-dispatch into the blocked implementation
+        monkeypatch.setattr(attention, "_DECODE_BLOCKED_MIN_S", 1 << 30)
+        ref = attention.gqa_attention(q, k, v, jnp.int32(pos), 1)
+        monkeypatch.undo()
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_gqa_dispatches_decode_blocked_for_long_cache():
+    from dllama_tpu.ops import attention
+
+    r = np.random.RandomState(1)
+    q = jnp.asarray(r.randn(1, 4, 1, 8), jnp.float32)
+    k = jnp.asarray(r.randn(1, 2, 4096, 8), jnp.float32)
+    v = jnp.asarray(r.randn(1, 2, 4096, 8), jnp.float32)
+    got = attention.gqa_attention(q, k, v, jnp.int32(77), 1)
+    ref = attention.decode_gqa_attention(q, k, v, jnp.int32(77))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
