@@ -76,6 +76,20 @@ def sp_update_kv_cache(k_cache: jax.Array, v_cache: jax.Array,
         out_specs=(kv_spec, kv_spec))(k_cache, v_cache, k_new, v_new)
 
 
+def _varying(x):
+    """Mark a freshly-created accumulator as device-varying over the mesh
+    (shard_map branch/carry types must match the computed side)."""
+    return jax.lax.pcast(x, ("dp", "sp", "tp"), to="varying")
+
+
+def _empty_partials(shape, dh):
+    """The (o_i, l_i, m_i) triple a fully-masked chunk produces — shared by
+    the ring accumulator init and the one-round path's skip branch."""
+    return (_varying(jnp.zeros(shape + (dh,), jnp.float32)),
+            _varying(jnp.zeros(shape, jnp.float32)),
+            _varying(jnp.full(shape, NEG_BIG, jnp.float32)))
+
+
 def _local_partials(q, k, v, pos, q_len, chunk_start):
     """Per-shard partial attention.
 
@@ -166,13 +180,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh,
             vb = jax.lax.ppermute(vb, "sp", perm)
             return out, lsum, m, kb, vb
 
-        shape = (q.shape[0], hkv_l, g, t_local)
-        varying = lambda x: jax.lax.pcast(x, ("dp", "sp", "tp"), to="varying")
-        # accumulators are per-shard values → mark them device-varying so
-        # the fori_loop carry type matches the loop body's outputs
-        init = (varying(jnp.zeros(shape + (dh,), jnp.float32)),
-                varying(jnp.zeros(shape, jnp.float32)),
-                varying(jnp.full(shape, NEG_BIG, jnp.float32)), k, v)
+        # accumulators start as a fully-masked chunk's partials, marked
+        # device-varying so the fori_loop carry type matches the body's
+        o0, l0, m0 = _empty_partials((q.shape[0], hkv_l, g, t_local), dh)
+        init = (o0, l0, m0, k, v)
         out, lsum, m, kb, vb = jax.lax.fori_loop(0, sp - 1, step, init)
         # final block: consume without the (discarded) sp-th rotation
         out, lsum, m = accumulate(sp - 1, out, lsum, m, kb, vb)
@@ -206,7 +217,20 @@ def sp_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         hkv_l = k.shape[1]
         qf = q.astype(jnp.float32).reshape(q.shape[0], hkv_l, hq_l // hkv_l, t, dh)
         chunk_start = jax.lax.axis_index("sp") * chunk
-        o_i, l_i, m_i = _local_partials(qf, k, v, pos, q_len, chunk_start)
+
+        def compute(_):
+            return _local_partials(qf, k, v, pos, q_len, chunk_start)
+
+        def empty(_):
+            return _empty_partials(qf.shape[:3] + (t,), dh)
+
+        # a shard whose whole chunk is in the queries' future is fully
+        # masked: skip its scores/einsums and its KV chunk read.  Step
+        # latency is unchanged (every shard still meets the collective
+        # below, paced by the shards that do compute) — the saving is the
+        # idle shards' HBM reads and FLOPs, not wall clock.
+        o_i, l_i, m_i = jax.lax.cond(
+            chunk_start <= pos + q_len - 1, compute, empty, None)
 
         m = jnp.max(jax.lax.all_gather(m_i, "sp"), axis=0)   # global max
         scale = jnp.exp(m_i - m)
