@@ -56,6 +56,12 @@ def _model_cfg(name):
         return tiny_config(dim=4096, hidden_dim=11008, n_layers=32, n_heads=32,
                            n_kv_heads=32, vocab_size=32000, seq_len=1024,
                            dtype=jnp.bfloat16)
+    if name == "llama2-7b-long":
+        # long-context variant: a 16k cache (2×4.3 GB bf16) next to the
+        # ~4 GB packed weights — decode stays fast only because attention
+        # reads the live prefix, not the whole cache (ops/attention.py
+        # decode_gqa_attention); logged as evidence, not the headline
+        return _model_cfg("llama2-7b").with_(seq_len=16384)
     if name == "tinyllama-1.1b":  # launch.py:7
         return tiny_config(dim=2048, hidden_dim=5632, n_layers=22, n_heads=32,
                            n_kv_heads=4, vocab_size=32000, seq_len=2048,
@@ -355,7 +361,10 @@ def run_attempt(name):
                        profile=(name == "llama2-7b"))
     toks = 1000.0 / ms
     backend = jax.default_backend()
-    if name == "llama2-7b":
+    if name == "llama2-7b-long":
+        metric = f"llama2-7b q40 greedy decode tok/s at seq_len 16384 (1 TPU chip, {impl})"
+        vs = None  # reference has no long-context capability to compare
+    elif name == "llama2-7b":
         metric = f"llama2-7b q40 greedy decode tok/s (1 TPU chip, {impl})"
         vs = round(toks / BASELINE_7B_TOKS, 2)
     elif name == "tinyllama-1.1b":
@@ -449,6 +458,14 @@ def main():
             cli_env = dict(hw_env)
             cli_env["BENCH_CLI_DEADLINE"] = str(time.time() + remaining() - 240)
             cli_out = _spawn("llama2-7b-cli", remaining() - 150, env_extra=cli_env)
+        # long-context decode evidence: 16k cache, decode stays near the 1k
+        # number because attention reads only the live prefix — stderr-only
+        if chunk_out and "llama2-7b" in chunk_out.get("metric", "") \
+                and remaining() > 560:
+            long_out = _spawn("llama2-7b-long", 300, env_extra=hw_env)
+            if long_out:
+                print(f"bench: long-context: {json.dumps(long_out)}",
+                      file=sys.stderr)
         # packed-MoE decode on hardware once (VERDICT r02 Next #5): the
         # QLayerView scalar-prefetch expert select must lower under Mosaic.
         # Runs after the headline stages so a hang here costs diagnostics,
