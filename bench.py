@@ -105,7 +105,7 @@ def _zero_q40_params(cfg):
             np_ = padded_n(n)
             params[k] = QTensor(
                 jnp.zeros((*lead, np_ // 2, d), jnp.uint8),
-                jnp.zeros((*lead, np_ // 32, d), jnp.float16), (n, d))
+                jnp.zeros((*lead, np_ // 32, d), jnp.uint16), (n, d))
         else:
             params[k] = jnp.zeros(shape, jnp.float32 if k.startswith("rms") else cfg.dtype)
     return params
@@ -200,41 +200,6 @@ def _child_env(extra: dict | None = None) -> dict:
     return env
 
 
-def _variant_sweep(budget_s: float) -> str:
-    """Mini-sweep on hardware: time each kernel dequant variant on the 7B
-    stacked shapes (tools/sweep_q40.measure_one, fresh subprocess per
-    variant) and return the fastest; 'classic' on any failure.  The chosen
-    variant configures the subsequent bench stages via DLLAMA_Q40_VARIANT —
-    evidence lands in the driver log (VERDICT r02 Next #2)."""
-    here = os.path.dirname(os.path.abspath(__file__))
-    t0 = time.time()
-    results = []
-    for variant in ("classic", "folded", "exact"):
-        left = budget_s - (time.time() - t0)
-        if left < 60:
-            print(f"bench: sweep budget exhausted before {variant}", file=sys.stderr)
-            break
-        try:
-            r = subprocess.run(
-                [sys.executable, os.path.join(here, "tools", "sweep_q40.py"),
-                 "--one", variant],
-                stdout=subprocess.PIPE, env=_child_env(), cwd=here,
-                timeout=min(left, 240))
-            out = json.loads(r.stdout.decode().strip().splitlines()[-1])
-            ms = out["proj_matmul_ms_per_token"]
-            results.append((ms, variant))
-            print(f"bench: sweep {variant}: {ms:.2f} ms/token matmuls "
-                  f"@ {out['proj_matmul_GBps']:.0f} GB/s", file=sys.stderr)
-        except Exception as e:
-            print(f"bench: sweep {variant} failed ({type(e).__name__}: "
-                  f"{str(e)[:120]})", file=sys.stderr)
-    if not results:
-        return "classic"
-    results.sort()
-    print(f"bench: sweep winner: {results[0][1]}", file=sys.stderr)
-    return results[0][1]
-
-
 def _profile_split_stderr(run_once, chunk):
     """Trace one decode chunk and log the compute/collective split — the
     reference's I/T attribution on a real TPU xplane (VERDICT r02 Next #4)."""
@@ -286,9 +251,11 @@ def _pallas_hw_check():
         return "xla"
 
 
-def _bench_decode(cfg, chunk=32, n_chunks=10, profile=False):
+def _bench_decode(cfg, chunk=32, n_chunks=10, profile=False, start_pos=0):
     """Greedy on-device decode loop; returns avg ms/token over the timed
-    chunks (compile + warmup excluded)."""
+    chunks (compile + warmup excluded).  ``start_pos`` places the decode
+    deep into the cache so long-context runs time attention over a long
+    *live* prefix, not an empty one."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -306,14 +273,15 @@ def _bench_decode(cfg, chunk=32, n_chunks=10, profile=False):
     tok = jnp.zeros((1,), jnp.int32)
     key = jax.random.PRNGKey(0)
     t0 = time.perf_counter()
-    toks, cache, tok, _, _ = fn(params, cache, tok, jnp.int32(0), key)  # compile+warmup
-    np.asarray(toks)
+    toks, cache, tok, _, _ = fn(params, cache, tok, jnp.int32(start_pos), key)
+    np.asarray(toks)  # compile+warmup
     print(f"compile+warmup: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     times = []
     for i in range(n_chunks):
         t0 = time.perf_counter()
-        toks, cache, tok, _, _ = fn(params, cache, tok, jnp.int32((i + 1) * chunk), key)
+        toks, cache, tok, _, _ = fn(params, cache, tok,
+                                    jnp.int32(start_pos + (i + 1) * chunk), key)
         np.asarray(toks)  # forces execution; only K int32 ids cross the boundary
         times.append((time.perf_counter() - t0) * 1000 / chunk)
 
@@ -323,7 +291,7 @@ def _bench_decode(cfg, chunk=32, n_chunks=10, profile=False):
         def run_once():
             toks, state["cache"], state["tok"], _, _ = fn(
                 params, state["cache"], state["tok"],
-                jnp.int32((n_chunks + 1) * chunk), key)
+                jnp.int32(start_pos + (n_chunks + 1) * chunk), key)
             np.asarray(toks)
 
         _profile_split_stderr(run_once, chunk)
@@ -357,12 +325,16 @@ def run_attempt(name):
         impl = _pallas_hw_check()
         chunk, n_chunks = 32, 10  # ≥10 timed chunks (ADVICE r02)
     cfg = cfg.with_(quant_impl=impl)
+    # long-context evidence decodes deep in the cache (live prefix ~15.7k),
+    # otherwise the "16k" number would really measure a ~350-token prefix
+    start = cfg.seq_len - 64 - (n_chunks + 2) * chunk if name.endswith("-long") else 0
     ms = _bench_decode(cfg, chunk=chunk, n_chunks=n_chunks,
-                       profile=(name == "llama2-7b"))
+                       profile=(name == "llama2-7b"), start_pos=start)
     toks = 1000.0 / ms
     backend = jax.default_backend()
     if name == "llama2-7b-long":
-        metric = f"llama2-7b q40 greedy decode tok/s at seq_len 16384 (1 TPU chip, {impl})"
+        metric = (f"llama2-7b q40 greedy decode tok/s at seq_len 16384, "
+                  f"live prefix ≥{start} (1 TPU chip, {impl})")
         vs = None  # reference has no long-context capability to compare
     elif name == "llama2-7b":
         metric = f"llama2-7b q40 greedy decode tok/s (1 TPU chip, {impl})"
@@ -429,12 +401,11 @@ def main():
 
     hw_env = {}
     if on_hw:
-        # pick the fastest kernel variant on this hardware first (bounded);
-        # everything after runs with it
-        if remaining() > 1000:
-            variant = _variant_sweep(min(remaining() - 800, 420))
-            if variant != "classic":
-                hw_env["DLLAMA_Q40_VARIANT"] = variant
+        # kernel variant/tile choice is settled offline (tools/sweep_q40.py
+        # + the xplane profile, docs/PERF.md): classic @ (1024, 1024) — an
+        # in-bench sweep at jit-scan fidelity would cost several minutes of
+        # compile per config, which this budget spends on the headline
+        # stages instead
         chunk_out = None
         for name in ("llama2-7b", "tinyllama-1.1b"):
             budget = remaining() - 360  # keep room for the CPU fallback
@@ -458,18 +429,11 @@ def main():
             cli_env = dict(hw_env)
             cli_env["BENCH_CLI_DEADLINE"] = str(time.time() + remaining() - 240)
             cli_out = _spawn("llama2-7b-cli", remaining() - 150, env_extra=cli_env)
-        # long-context decode evidence: 16k cache, decode stays near the 1k
-        # number because attention reads only the live prefix — stderr-only
-        if chunk_out and "llama2-7b" in chunk_out.get("metric", "") \
-                and remaining() > 560:
-            long_out = _spawn("llama2-7b-long", 300, env_extra=hw_env)
-            if long_out:
-                print(f"bench: long-context: {json.dumps(long_out)}",
-                      file=sys.stderr)
         # packed-MoE decode on hardware once (VERDICT r02 Next #5): the
         # QLayerView scalar-prefetch expert select must lower under Mosaic.
-        # Runs after the headline stages so a hang here costs diagnostics,
-        # not the number.
+        # Runs after the headline stages (a hang here costs diagnostics, not
+        # the number) but before the optional long-context stage, which must
+        # not starve it of budget.
         if chunk_out and remaining() > 300:
             here = os.path.dirname(os.path.abspath(__file__))
             try:
@@ -484,6 +448,14 @@ def main():
                       file=sys.stderr)
             except Exception as e:
                 print(f"bench: moe hw check failed ({type(e).__name__})",
+                      file=sys.stderr)
+        # long-context decode evidence: 16k cache, decode deep in a live
+        # prefix stays usable because attention reads O(pos) — stderr-only
+        if chunk_out and "llama2-7b" in chunk_out.get("metric", "") \
+                and remaining() > 560:
+            long_out = _spawn("llama2-7b-long", 300, env_extra=hw_env)
+            if long_out:
+                print(f"bench: long-context: {json.dumps(long_out)}",
                       file=sys.stderr)
         if cli_out:
             print(f"bench: decode_chunk cross-check: {json.dumps(chunk_out)}",

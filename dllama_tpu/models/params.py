@@ -148,7 +148,7 @@ def _stack_q_experts(mf: mfile.MFile, cfg: ModelConfig, fname: str) -> q40.QTens
         for e in range(E):
             q40.repack_file_bytes_into(
                 mf.raw(f"layers.{l}.experts.{e}.{fname}"), d, n, qp[l, e], sc[l, e])
-    return q40.QTensor(jnp.asarray(qp), jnp.asarray(sc), (n, d))
+    return q40.QTensor(jnp.asarray(qp), jnp.asarray(sc.view(np.uint16)), (n, d))
 
 
 def load_params(mf: mfile.MFile, cfg: ModelConfig | None = None,

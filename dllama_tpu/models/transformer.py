@@ -30,9 +30,9 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import q40
-from ..ops.attention import gqa_attention, update_kv_cache
+from ..ops.attention import gqa_attention, update_kv_cache_at
 from ..ops.kernels import ACTIVATIONS, apply_rope, rmsnorm, rope_angles, softmax_f32
-from ..ops.sp_attention import ring_attention, sp_gqa_attention, sp_update_kv_cache
+from ..ops.sp_attention import ring_attention, sp_gqa_attention, sp_update_kv_cache_at
 from ..parallel.mesh import get_active_mesh
 from .config import ModelConfig
 from .params import Params
@@ -66,7 +66,12 @@ def _mm(x, w, cfg: ModelConfig, kind: str | None = None):
     return q40.mm(x, w, impl=cfg.quant_impl, kind=kind).astype(cfg.dtype)
 
 
-def _attention_block(x, lp, cfg: ModelConfig, k_cache, v_cache, cos, sin, pos):
+def _attention_block(x, lp, cfg: ModelConfig, ck, cv, cos, sin, pos, layer):
+    """One attention sub-block.  ``ck``/``cv`` are the *stacked*
+    (L, B, Hkv, S, Dh) caches carried through the layer scan; this layer
+    writes its (B, Hkv, T, Dh) step window in place at ``(layer, pos)`` and
+    reads back only its own layer slice for attention (see
+    ops.attention.update_kv_cache_at for the cost model)."""
     b, t, d = x.shape
     hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_size
 
@@ -89,14 +94,16 @@ def _attention_block(x, lp, cfg: ModelConfig, k_cache, v_cache, cos, sin, pos):
     k = k.transpose(0, 2, 1, 3)
     v = v.transpose(0, 2, 1, 3)
     mesh = get_active_mesh()
+    ring = (mesh is not None and mesh.shape.get("sp", 1) > 1
+            and cfg.ring_prefill and t > 1)
     if t == 1 and mesh is not None and mesh.shape.get("sp", 1) > 1:
         # seq-sharded cache: explicit shard-local write (no GSPMD-chosen
         # gather/scatter per decode step)
-        k_cache, v_cache = sp_update_kv_cache(k_cache, v_cache, k, v, pos, mesh)
+        ck, cv = sp_update_kv_cache_at(ck, cv, k, v, layer, pos, mesh)
     else:
-        k_cache, v_cache = update_kv_cache(k_cache, v_cache, k, v, pos)
+        ck, cv = update_kv_cache_at(ck, cv, k, v, layer, pos)
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
-        if cfg.ring_prefill and t > 1:
+        if ring:
             # from-scratch prefill: the fresh block IS the whole history
             # (engine gates this on pos==0), so attend blockwise over the
             # sequence-sharded q/k/v ring — no cache read, O(T/sp) memory
@@ -104,12 +111,16 @@ def _attention_block(x, lp, cfg: ModelConfig, k_cache, v_cache, cos, sin, pos):
         else:
             # sequence-parallel decode / continuation: seq-sharded cache,
             # one-round distributed softmax combine
-            att = sp_gqa_attention(q, k_cache, v_cache, pos, t, mesh)
+            k_l = jax.lax.dynamic_index_in_dim(ck, layer, 0, keepdims=False)
+            v_l = jax.lax.dynamic_index_in_dim(cv, layer, 0, keepdims=False)
+            att = sp_gqa_attention(q, k_l, v_l, pos, t, mesh)
     else:
-        att = gqa_attention(q, k_cache, v_cache, pos, t)
+        k_l = jax.lax.dynamic_index_in_dim(ck, layer, 0, keepdims=False)
+        v_l = jax.lax.dynamic_index_in_dim(cv, layer, 0, keepdims=False)
+        att = gqa_attention(q, k_l, v_l, pos, t)
     att = att.transpose(0, 2, 1, 3).reshape(b, t, hq * dh)
     out = _mm(att, lp["wo"], cfg, kind="col")  # col-sharded: partial sums all-reduced here
-    return out, k_cache, v_cache
+    return out, ck, cv
 
 
 def _dense_ffn(xb, lp, cfg: ModelConfig):
@@ -228,12 +239,13 @@ def run_blocks(params: Params, cfg: ModelConfig, tokens: jax.Array,
     qt_keys = [k for k in layer_keys if isinstance(params[k], q40.QTensor)]
     stacked = {k: params[k] for k in layer_keys if k not in qt_keys}
 
-    def block(x, layer):
-        idx, lp, k_cache, v_cache = layer
+    def block(carry, layer):
+        x, ck, cv = carry
+        idx, lp = layer
         lp = dict(lp)
         for k in qt_keys:
             lp[k] = q40.QLayerView(params[k], idx)
-        att_out, k_cache, v_cache = _attention_block(x, lp, cfg, k_cache, v_cache, cos, sin, pos)
+        att_out, ck, cv = _attention_block(x, lp, cfg, ck, cv, cos, sin, pos, idx)
         if cfg.post_block_norms:
             att_out = rmsnorm(att_out, lp["rms_ffn"])  # grokRmfFfnNorm
         x = x + att_out
@@ -248,10 +260,15 @@ def run_blocks(params: Params, cfg: ModelConfig, tokens: jax.Array,
             xb = rmsnorm(x, lp["rms_ffn"])
             ff = _dense_ffn(xb, lp, cfg)
         x = x + ff
-        return x, (k_cache, v_cache)
+        return (x, ck, cv), None
 
-    x, (k_new, v_new) = jax.lax.scan(
-        block, x, (jnp.arange(cfg.n_layers), stacked, cache.k, cache.v))
+    # The stacked caches are scan *carries*, not xs/ys: each layer touches
+    # only its own (layer, pos) window in place.  Routing them through
+    # xs/ys makes XLA slice out and restack a full layer slab per step and
+    # defensively copy the whole cache in the enclosing decode loop —
+    # measured ~8 ms/token at 7B/1k, comparable to all the matmuls.
+    (x, k_new, v_new), _ = jax.lax.scan(
+        block, (x, cache.k, cache.v), (jnp.arange(cfg.n_layers), stacked))
     return x, KVCache(k_new, v_new)
 
 

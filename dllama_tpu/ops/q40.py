@@ -18,10 +18,11 @@ block):
   nibble and logical row ``32b + 16 + r`` in its high nibble, biased +8.
   (The reference's own BlockQ40 uses the same lo/hi split within a block,
   quants.hpp:17-20.)
-* ``scales`` f16 ``(..., N/32, D)`` — the per-block f16 deltas exactly as
-  the `.m` file stores them (quants.hpp:17-20), 0.0625 B/weight; widened
-  on the fly (f16→f32 is exact, so dequantization is bit-identical to the
-  reference codec).
+* ``scales`` uint16 ``(..., N/32, D)`` — the per-block f16 deltas exactly
+  as the `.m` file stores them (quants.hpp:17-20), 0.0625 B/weight, held
+  as raw bits because the Mosaic dialect has no f16 type; both matmul
+  paths widen f16-bits→f32 exactly (subnormals included), so
+  dequantization is bit-identical to the reference codec.
 
 Two matmul implementations:
 
@@ -96,8 +97,8 @@ class QTensor:
 
     Storage rows cover ``padded_n(n)`` input positions (see above)."""
 
-    qpacked: jax.Array          # uint8 (..., padded_n/2, d)
-    scales: jax.Array           # f16   (..., padded_n/32, d)
+    qpacked: jax.Array          # uint8  (..., padded_n/2, d)
+    scales: jax.Array           # uint16 (..., padded_n/32, d) — f16 bits
     logical_nd: tuple[int, int] = field(metadata=dict(static=True))
 
     @property
@@ -131,9 +132,10 @@ def pack_planes_np(qvals: np.ndarray, scales: np.ndarray
 
 
 def pack_planes(qvals: np.ndarray, scales: np.ndarray) -> QTensor:
-    """Device-array wrapper over :func:`pack_planes_np`."""
+    """Device-array wrapper over :func:`pack_planes_np` (scales upload as
+    their f16 bit pattern — see the module docstring)."""
     packed, sc, nd = pack_planes_np(qvals, scales)
-    return QTensor(jnp.asarray(packed), jnp.asarray(sc), nd)
+    return QTensor(jnp.asarray(packed), jnp.asarray(sc.view(np.uint16)), nd)
 
 
 def quantize(w: np.ndarray) -> QTensor:
@@ -218,11 +220,12 @@ def pack_file_groups(groups: list[list[tuple[np.ndarray, int, int]]],
                 raise ValueError(f"fused group mixes input dims {gn} != {n}")
             repack_file_bytes_into(raw, d, n, qp[l], sc[l], col)
             col += d
+    scu = sc.view(np.uint16)
     if not stacked:
         if L != 1:
             raise ValueError("stacked=False needs exactly one group")
-        return QTensor(jnp.asarray(qp[0]), jnp.asarray(sc[0]), (n, d_total))
-    return QTensor(jnp.asarray(qp), jnp.asarray(sc), (n, d_total))
+        return QTensor(jnp.asarray(qp[0]), jnp.asarray(scu[0]), (n, d_total))
+    return QTensor(jnp.asarray(qp), jnp.asarray(scu), (n, d_total))
 
 
 def split_d(qt: QTensor, sizes: list[int]) -> list[QTensor]:
@@ -243,6 +246,29 @@ def split_d(qt: QTensor, sizes: list[int]) -> list[QTensor]:
     return out
 
 
+def widen_scales(s: jax.Array) -> jax.Array:
+    """uint16 f16-bit scales → f32 (exact); f16/f32 pass through.  XLA path
+    only — inside the Pallas kernel use :func:`_f16_bits_to_f32`."""
+    if s.dtype == jnp.uint16:
+        s = jax.lax.bitcast_convert_type(s, jnp.float16)
+    return s.astype(jnp.float32)
+
+
+def _f16_bits_to_f32(u: jax.Array) -> jax.Array:
+    """Widen f16 *bit patterns* (any uint dtype) to f32 with integer math —
+    the Mosaic dialect has no f16 type, so the kernel rebuilds the IEEE
+    fields by hand; exact for normals and subnormals (inf/nan map to large
+    finite values, which codec scales never contain)."""
+    u = u.astype(jnp.int32)
+    sign = (u >> 15) << 31
+    exp = (u >> 10) & 0x1F
+    mant = u & 0x3FF
+    normal = jax.lax.bitcast_convert_type(
+        sign | ((exp + 112) << 23) | (mant << 13), jnp.float32)
+    sub = jnp.where(sign != 0, -1.0, 1.0) * mant.astype(jnp.float32) * 2.0 ** -24
+    return jnp.where(exp == 0, sub, normal)
+
+
 def dequantize(qt: QTensor, dtype=jnp.float32) -> jax.Array:
     """Reconstruct the dense array (tests / the XLA matmul path)."""
     *lead, n2, d = qt.qpacked.shape
@@ -251,7 +277,7 @@ def dequantize(qt: QTensor, dtype=jnp.float32) -> jax.Array:
     lo = (v & 0xF).astype(jnp.float32)
     hi = (v >> 4).astype(jnp.float32)
     w = jnp.concatenate([lo, hi], axis=-2) - 8.0          # (..., nb, 32, d)
-    w = w * qt.scales[..., :, None, :]
+    w = w * widen_scales(qt.scales)[..., :, None, :]
     w = w.reshape(*lead, nb * 32, d)
     n = qt.logical_nd[0]
     if n != nb * 32:
@@ -263,7 +289,7 @@ def dequantize(qt: QTensor, dtype=jnp.float32) -> jax.Array:
 # Pallas fused kernel
 # ---------------------------------------------------------------------------
 
-def _q40_kernel(xlo_ref, xhi_ref, xs_ref, qp_ref, s_ref, o_ref, acc_ref, *,
+def _q40_kernel(xlo_ref, xhi_ref, bsum_ref, qp_ref, s_ref, o_ref, acc_ref, *,
                 nsteps, variant):
     """One (tile_n × tile_d) fused dequant-matmul step.
 
@@ -279,21 +305,35 @@ def _q40_kernel(xlo_ref, xhi_ref, xs_ref, qp_ref, s_ref, o_ref, acc_ref, *,
     * ``folded``  — the −8 bias never touches the weights: with
       ``w=(v−8)·s``, ``x·w = x·(v·s) − 8·(Σ_block x)·s``, so the kernel
       feeds the MXU ``bf16(v)·bf16(s)`` and corrects with a per-block dot
-      against precomputed block sums of x; ~3.5 VPU ops/weight, rounding
+      against block sums of x; ~3.5 VPU ops/weight, rounding
       ~2× classic (still an order below the codec's ±s/2).
     * ``exact``   — per-block batched dots of the *raw* nibbles (integers
       ≤15, exact in bf16), scales applied per (block, column) in f32
       afterwards; ~2.5 VPU ops/weight and *less* rounding than classic —
       but its (nb, t, 16)×(nb, 16, td) batched dots stress the MXU with
       K=16 passes, so its win is hardware-dependent.
+
+    ``bsum_ref`` is a constant (tn/2, nb) 0/1 matrix (full-array block, so
+    its 32-wide lane dim is legal under Mosaic's block-shape rules, which a
+    (t, tile_n/32) streamed input is not); ``folded``/``exact`` recover the
+    per-block activation sums with two tiny MXU dots instead of a streamed
+    ``xs`` operand.
     """
     i = pl.program_id(1)
     qp = qp_ref[...]                                      # (tn/2, td) uint8
     tn2, td = qp.shape[-2:]
     qp = qp.reshape(tn2, td)
     nb = tn2 // 16
-    s = s_ref[...].reshape(nb, td)                        # f16
+    sbits = s_ref[...].reshape(nb, td)                    # uint16 f16 bits
+    s32 = _f16_bits_to_f32(sbits)                         # (nb, td) f32, exact
     vi = qp.astype(jnp.int32)
+
+    def block_sums():
+        """Per-block sums of this tile's activations: (t, nb) f32 — the
+        whole block's sum is the sum over its lo and hi halves."""
+        b = bsum_ref[:]
+        return (jnp.dot(xlo_ref[:], b, preferred_element_type=jnp.float32)
+                + jnp.dot(xhi_ref[:], b, preferred_element_type=jnp.float32))
 
     if variant == "exact":
         lo = (vi & 0xF).astype(jnp.bfloat16).reshape(nb, 16, td)
@@ -307,24 +347,23 @@ def _q40_kernel(xlo_ref, xhi_ref, xs_ref, qp_ref, s_ref, o_ref, acc_ref, *,
             dimension_numbers=(((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
         p = dot(xlo, lo) + dot(xhi, hi)                   # (nb, t, td)
-        s32 = s.astype(jnp.float32)
-        corr = p - 8.0 * xs_ref[:].astype(jnp.float32).swapaxes(0, 1)[:, :, None]
+        corr = p - 8.0 * block_sums().swapaxes(0, 1)[:, :, None]
         part = jnp.sum(corr * s32[:, None, :], axis=0)    # (t, td)
     else:
         if variant == "classic":
-            s32 = s.astype(jnp.float32)
             lo = ((vi & 0xF).astype(jnp.float32) - 8.0).reshape(nb, 16, td)
             hi = ((vi >> 4).astype(jnp.float32) - 8.0).reshape(nb, 16, td)
             lo = (lo * s32[:, None, :]).astype(jnp.bfloat16).reshape(tn2, td)
             hi = (hi * s32[:, None, :]).astype(jnp.bfloat16).reshape(tn2, td)
             bias = 0.0
         else:  # folded
-            sb = s.astype(jnp.bfloat16)
+            sb = s32.astype(jnp.bfloat16)
             lo = (vi & 0xF).astype(jnp.bfloat16).reshape(nb, 16, td)
             hi = (vi >> 4).astype(jnp.bfloat16).reshape(nb, 16, td)
             lo = (lo * sb[:, None, :]).reshape(tn2, td)
             hi = (hi * sb[:, None, :]).reshape(tn2, td)
-            bias = 8.0 * jnp.dot(xs_ref[:], sb, preferred_element_type=jnp.float32)
+            bias = 8.0 * jnp.dot(block_sums().astype(jnp.bfloat16), sb,
+                                 preferred_element_type=jnp.float32)
         part = (jnp.dot(xlo_ref[:], lo, preferred_element_type=jnp.float32)
                 + jnp.dot(xhi_ref[:], hi, preferred_element_type=jnp.float32)
                 - bias)
@@ -342,25 +381,33 @@ def _q40_kernel(xlo_ref, xhi_ref, xs_ref, qp_ref, s_ref, o_ref, acc_ref, *,
         o_ref[:] = acc_ref[:]
 
 
-def _stacked_q40_kernel(lidx_ref, xlo_ref, xhi_ref, xs_ref, qp_ref, s_ref,
+def _stacked_q40_kernel(lidx_ref, xlo_ref, xhi_ref, bsum_ref, qp_ref, s_ref,
                         o_ref, acc_ref, *, nsteps, variant):
     del lidx_ref  # consumed by the index_maps
-    _q40_kernel(xlo_ref, xhi_ref, xs_ref, qp_ref, s_ref, o_ref, acc_ref,
+    _q40_kernel(xlo_ref, xhi_ref, bsum_ref, qp_ref, s_ref, o_ref, acc_ref,
                 nsteps=nsteps, variant=variant)
 
 
-def _x_parts(x: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Split activations (t, n) into the packed-row-order halves and block
-    sums the kernel contracts against: ``x_lo``/``x_hi`` (t, n/2) matching
-    the low/high nibble planes, ``xs`` (t, n/32) per-block sums for the −8
-    bias correction."""
+def _x_parts(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Split activations (t, n) into the packed-row-order halves the kernel
+    contracts against: ``x_lo``/``x_hi`` (t, n/2) matching the low/high
+    nibble planes."""
     t, n = x.shape
     nb = n // 32
     xr = x.reshape(t, nb, 32)
     x_lo = xr[:, :, :16].reshape(t, n // 2)
     x_hi = xr[:, :, 16:].reshape(t, n // 2)
-    xs = xr.astype(jnp.float32).sum(axis=-1).astype(jnp.bfloat16)
-    return x_lo, x_hi, xs
+    return x_lo, x_hi
+
+
+@functools.cache
+def _bsum_mat(tile_n: int) -> np.ndarray:
+    """Constant (tile_n/2, tile_n/32) block-summing matrix: column b is the
+    indicator of packed rows [16b, 16b+16) — one half of quantization block
+    b — so ``x_half @ B`` yields that half's per-block sums."""
+    nb = tile_n // 32
+    return np.kron(np.eye(nb, dtype=np.float32),
+                   np.ones((16, 1), np.float32)).astype(jnp.bfloat16)
 
 
 def _check_variant(variant: str | None) -> str:
@@ -398,7 +445,8 @@ def _pallas_matmul(x: jax.Array, qpacked: jax.Array, scales: jax.Array,
     d = qpacked.shape[-1]
     tile_n, tile_d = tiles or _tiles(n, d)
     grid = (pl.cdiv(d, tile_d), n // tile_n)
-    x_lo, x_hi, xs = _x_parts(x.astype(jnp.bfloat16))
+    x_lo, x_hi = _x_parts(x.astype(jnp.bfloat16))
+    bsum = jnp.asarray(_bsum_mat(tile_n))
     return pl.pallas_call(
         functools.partial(_q40_kernel, nsteps=grid[1],
                           variant=_check_variant(variant)),
@@ -406,7 +454,7 @@ def _pallas_matmul(x: jax.Array, qpacked: jax.Array, scales: jax.Array,
         in_specs=[
             pl.BlockSpec((t, tile_n // 2), lambda j, i: (0, i), memory_space=pltpu.VMEM),
             pl.BlockSpec((t, tile_n // 2), lambda j, i: (0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((t, tile_n // 32), lambda j, i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec(bsum.shape, lambda j, i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((tile_n // 2, tile_d), lambda j, i: (i, j), memory_space=pltpu.VMEM),
             pl.BlockSpec((tile_n // 32, tile_d), lambda j, i: (i, j), memory_space=pltpu.VMEM),
         ],
@@ -416,7 +464,7 @@ def _pallas_matmul(x: jax.Array, qpacked: jax.Array, scales: jax.Array,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(x_lo, x_hi, xs, qpacked, scales)
+    )(x_lo, x_hi, bsum, qpacked, scales)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "variant"))
@@ -436,7 +484,8 @@ def _pallas_matmul_stacked(x: jax.Array, qpacked: jax.Array, scales: jax.Array,
     d = qpacked.shape[-1]
     tile_n, tile_d = _tiles(n, d)
     grid = (pl.cdiv(d, tile_d), n // tile_n)
-    x_lo, x_hi, xs = _x_parts(x.astype(jnp.bfloat16))
+    x_lo, x_hi = _x_parts(x.astype(jnp.bfloat16))
+    bsum = jnp.asarray(_bsum_mat(tile_n))
     out = pl.pallas_call(
         functools.partial(_stacked_q40_kernel, nsteps=grid[1],
                           variant=_check_variant(variant)),
@@ -446,7 +495,7 @@ def _pallas_matmul_stacked(x: jax.Array, qpacked: jax.Array, scales: jax.Array,
             in_specs=[
                 pl.BlockSpec((t, tile_n // 2), lambda j, i, l: (0, i)),
                 pl.BlockSpec((t, tile_n // 2), lambda j, i, l: (0, i)),
-                pl.BlockSpec((t, tile_n // 32), lambda j, i, l: (0, i)),
+                pl.BlockSpec(bsum.shape, lambda j, i, l: (0, 0)),
                 pl.BlockSpec((1, tile_n // 2, tile_d), lambda j, i, l: (l[0], i, j)),
                 pl.BlockSpec((1, tile_n // 32, tile_d), lambda j, i, l: (l[0], i, j)),
             ],
@@ -457,7 +506,7 @@ def _pallas_matmul_stacked(x: jax.Array, qpacked: jax.Array, scales: jax.Array,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(layer.reshape(1).astype(jnp.int32), x_lo, x_hi, xs, qpacked, scales)
+    )(layer.reshape(1).astype(jnp.int32), x_lo, x_hi, bsum, qpacked, scales)
     return out
 
 

@@ -20,9 +20,9 @@ makes sense for sharded-Q prefill; for decode and replicated-Q prefill
 the one-round combine is strictly better.)
 
 Prefill KV cache *updates* stay with GSPMD (``ops.attention.
-update_kv_cache``'s plain dynamic_update_slice — the block write is
+update_kv_cache_at``'s plain dynamic_update_slice — the block write is
 amortized over the whole prompt); the per-step decode write uses
-:func:`sp_update_kv_cache`, whose shard_map makes the write shard-local
+:func:`sp_update_kv_cache_at`, whose shard_map makes the write shard-local
 by construction instead of trusting GSPMD's lowering choice.
 """
 
@@ -35,38 +35,46 @@ from jax.sharding import PartitionSpec as P
 NEG_BIG = -1e30  # stand-in for -inf that keeps exp() NaN-free on empty shards
 
 
-def sp_update_kv_cache(k_cache: jax.Array, v_cache: jax.Array,
-                       k_new: jax.Array, v_new: jax.Array,
-                       pos: jax.Array, mesh,
-                       kv_spec: P = P("dp", "tp", "sp", None),
-                       new_spec: P = P("dp", "tp", None, None)
-                       ) -> tuple[jax.Array, jax.Array]:
-    """Decode-step KV write on a seq-sharded cache, provably shard-local.
+def sp_update_kv_cache_at(k_cache: jax.Array, v_cache: jax.Array,
+                          k_new: jax.Array, v_new: jax.Array,
+                          layer: jax.Array, pos: jax.Array, mesh,
+                          kv_spec: P = P(None, "dp", "tp", "sp", None),
+                          new_spec: P = P("dp", "tp", None, None)
+                          ) -> tuple[jax.Array, jax.Array]:
+    """Decode-step KV write for *stacked* (L, B, Hkv, S, Dh) caches carried
+    through the layer scan: writes one layer's decode-step row at
+    ``(layer, pos)``, shard-local by construction (see
+    ops.attention.update_kv_cache_at for why the caches are carried).
 
     A plain ``dynamic_update_slice`` on an sp-sharded cache leaves the
     lowering to GSPMD, which is *correct* but free to insert a
-    gather/scatter per step (VERDICT r02 Weak #6).  Under ``shard_map``
-    the write is explicit: every shard runs the same update with the
-    position clamped into its local range, and a mask keeps non-owning
-    shards' rows unchanged — no communication by construction (the new
-    row is replicated over ``sp``).
+    gather/scatter per step.  Under ``shard_map`` the write is explicit:
+    every shard runs the same update with the position clamped into its
+    local range, and a mask keeps non-owning shards' rows unchanged — no
+    communication by construction (the new row is replicated over ``sp``).
 
-    Per-layer caches (B, Hkv, S, Dh) with S on ``sp``; ``k_new``/``v_new``
-    are one step's (B, Hkv, 1, Dh), replicated over ``sp``.
-    """
+    Decode-only: exactly one token (T == 1) per call — a T-token window
+    could straddle an ``sp`` shard boundary, which this single-row
+    ownership logic does not implement (prefill block writes go through
+    the GSPMD path in the transformer instead)."""
+    if k_new.shape[2] != 1:
+        raise ValueError(
+            f"sp_update_kv_cache_at writes one decode step, got T={k_new.shape[2]}")
     sp = mesh.shape.get("sp", 1)
-    chunk = k_cache.shape[2] // sp
+    chunk = k_cache.shape[3] // sp
 
     def shard_fn(kc, vc, kn, vn):
         i = jax.lax.axis_index("sp")
         local = pos - i * chunk
         owned = (local >= 0) & (local < chunk)
         idx = jnp.clip(local, 0, chunk - 1)
+        zero = jnp.zeros((), layer.dtype)
+        start = (layer, zero, zero, idx.astype(layer.dtype), zero)
 
         def write(cache, new):
-            row = jax.lax.dynamic_slice_in_dim(cache, idx, 1, axis=2)
-            new = jnp.where(owned, new.astype(cache.dtype), row)
-            return jax.lax.dynamic_update_slice_in_dim(cache, new, idx, axis=2)
+            row = jax.lax.dynamic_slice(cache, start, (1,) + new.shape[:2] + (1, new.shape[-1]))
+            new = jnp.where(owned, new[None, :, :, :1].astype(cache.dtype), row)
+            return jax.lax.dynamic_update_slice(cache, new, start)
 
         return write(kc, kn), write(vc, vn)
 
@@ -98,7 +106,9 @@ def _local_partials(q, k, v, pos, q_len, chunk_start):
     Returns (o_i (B,Hkv,G,T,Dh), l_i (B,Hkv,G,T), m_i (B,Hkv,G,T)).
     """
     c = k.shape[2]
-    scores = jnp.einsum("bhgtd,bhsd->bhgts", q, k.astype(jnp.float32))
+    # cache-dtype operands + f32 accumulation (see attention._online_fold)
+    scores = jnp.einsum("bhgtd,bhsd->bhgts", q.astype(k.dtype), k,
+                        preferred_element_type=jnp.float32)
     scores = scores / jnp.sqrt(jnp.float32(q.shape[-1]))
 
     s_idx = chunk_start + jnp.arange(c)[None, :]          # global key positions
@@ -109,7 +119,8 @@ def _local_partials(q, k, v, pos, q_len, chunk_start):
     m_i = jnp.maximum(jnp.max(scores, axis=-1), NEG_BIG)   # (B,Hkv,G,T)
     p = jnp.exp(scores - m_i[..., None])                   # masked → exp(-inf)=0
     l_i = jnp.sum(p, axis=-1)
-    o_i = jnp.einsum("bhgts,bhsd->bhgtd", p, v.astype(jnp.float32))
+    o_i = jnp.einsum("bhgts,bhsd->bhgtd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
     return o_i, l_i, m_i
 
 

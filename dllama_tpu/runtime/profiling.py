@@ -29,6 +29,9 @@ _COLLECTIVE = re.compile(
 # dynamic-update-slice); runtime/host events (Rendezvous, PjitFunction(...),
 # "Wait: ...") are not op time and are excluded.
 _HLO_NAME = re.compile(r"^[a-z][a-z0-9_.\-]*$")
+# TPU device planes record full HLO instruction strings
+# ('%fusion.3 = bf16[...]{...} fusion(...)'); the op name is the lhs.
+_HLO_INSTR = re.compile(r"^%([A-Za-z0-9_.\-]+) =")
 
 
 def _iter_op_events(path: str):
@@ -46,11 +49,27 @@ def _iter_op_events(path: str):
         if not (plane.name.startswith("/device:") or plane.name == "/host:CPU"):
             continue
         md = {m.id: m.name for m in plane.event_metadata.values()}
-        for line in plane.lines:
+        lines = plane.lines
+        # TPU planes split events into 'XLA Modules' (whole program),
+        # 'XLA Ops' (per-op), and 'Async XLA Ops' (a subset); only the
+        # per-op line counts, the others would double-book the same time.
+        op_lines = [ln for ln in lines if ln.name == "XLA Ops"]
+        if op_lines:
+            lines = op_lines
+        for line in lines:
             for ev in line.events:
                 name = md.get(ev.metadata_id, "")
-                if _HLO_NAME.match(name):
-                    yield name, ev.duration_ps
+                m = _HLO_INSTR.match(name)
+                if m:
+                    name = m.group(1)
+                elif not _HLO_NAME.match(name):
+                    continue
+                # control-flow wrappers nest their body ops' events inside
+                # their own span on the same line — counting both would
+                # double-book every loop body
+                if name.split(".")[0] in ("while", "conditional", "call"):
+                    continue
+                yield name, ev.duration_ps
 
 
 def op_times(trace_dir: str) -> dict[str, float]:

@@ -9,7 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dllama_tpu.ops.attention import (blocked_gqa_attention, gqa_attention,
-                                      update_kv_cache)
+                                      update_kv_cache_at)
 
 
 def _setup(b=1, hq=4, hkv=2, s=256, t=8, dh=16, pos=64, seed=0):
@@ -74,17 +74,21 @@ def test_decode_step_still_oneshot_consistent():
 
 
 def test_update_then_attend_roundtrip():
-    """update_kv_cache + attention sees exactly the written keys."""
-    b, hkv, s, dh = 1, 2, 64, 8
-    kc = jnp.zeros((b, hkv, s, dh))
-    vc = jnp.zeros((b, hkv, s, dh))
+    """update_kv_cache_at + attention sees exactly the written keys: the
+    stacked-cache layer write lands in the right (layer, pos) window."""
+    L, b, hkv, s, dh = 3, 1, 2, 64, 8
+    kc = jnp.zeros((L, b, hkv, s, dh))
+    vc = jnp.zeros((L, b, hkv, s, dh))
     rng = np.random.RandomState(2)
     kn = jnp.asarray(rng.randn(b, hkv, 4, dh).astype(np.float32))
     vn = jnp.asarray(rng.randn(b, hkv, 4, dh).astype(np.float32))
-    kc, vc = update_kv_cache(kc, vc, kn, vn, jnp.int32(0))
+    kc, vc = update_kv_cache_at(kc, vc, kn, vn, jnp.int32(1), jnp.int32(0))
+    # untouched layers stay zero; the written layer holds kn/vn at pos 0
+    assert float(jnp.abs(kc[0]).sum()) == 0.0 and float(jnp.abs(kc[2]).sum()) == 0.0
+    np.testing.assert_array_equal(np.asarray(kc[1, :, :, :4]), np.asarray(kn))
     q = jnp.asarray(rng.randn(b, 4, 4, dh).astype(np.float32))
-    out1 = gqa_attention(q, kc, vc, jnp.int32(0), 4)
-    out2 = blocked_gqa_attention(q, kc, vc, jnp.int32(0), 4)
+    out1 = gqa_attention(q, kc[1], vc[1], jnp.int32(0), 4)
+    out2 = blocked_gqa_attention(q, kc[1], vc[1], jnp.int32(0), 4)
     np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
                                rtol=1e-5, atol=1e-5)
 

@@ -35,7 +35,7 @@ def test_numpy_repack_matches_reference_pipeline():
     qp, sc = _repack(raw, d, n, use_native=False)
     ref = q40.pack_planes_t(*quants.q40_planes(raw, (d, n)))
     np.testing.assert_array_equal(qp, np.asarray(ref.qpacked))
-    np.testing.assert_array_equal(sc, np.asarray(ref.scales))
+    np.testing.assert_array_equal(sc.view(np.uint16), np.asarray(ref.scales))
 
 
 @pytest.mark.skipif(not native.have_native(), reason="libq40pack.so not built")

@@ -70,20 +70,31 @@ class TestSpCacheUpdate:
     @needs_8
     @pytest.mark.parametrize("pos", [0, 7, 8, 31])
     def test_shard_local_write_equals_plain_update(self, pos):
-        from dllama_tpu.ops.attention import update_kv_cache
-        from dllama_tpu.ops.sp_attention import sp_update_kv_cache
+        from dllama_tpu.ops.attention import update_kv_cache_at
+        from dllama_tpu.ops.sp_attention import sp_update_kv_cache_at
 
         mesh = make_mesh(tp=2, sp=4, dp=1, devices=jax.devices()[:8])
         r = np.random.RandomState(pos)
-        kc = jnp.asarray(r.randn(1, 2, 32, 8), jnp.float32)
-        vc = jnp.asarray(r.randn(1, 2, 32, 8), jnp.float32)
+        L, layer = 3, jnp.int32(1)
+        kc = jnp.asarray(r.randn(L, 1, 2, 32, 8), jnp.float32)
+        vc = jnp.asarray(r.randn(L, 1, 2, 32, 8), jnp.float32)
         kn = jnp.asarray(r.randn(1, 2, 1, 8), jnp.float32)
         vn = jnp.asarray(r.randn(1, 2, 1, 8), jnp.float32)
-        ek, ev = update_kv_cache(kc, vc, kn, vn, jnp.int32(pos))
-        gk, gv = jax.jit(lambda *a: sp_update_kv_cache(*a, jnp.int32(pos), mesh))(
-            kc, vc, kn, vn)
+        ek, ev = update_kv_cache_at(kc, vc, kn, vn, layer, jnp.int32(pos))
+        gk, gv = jax.jit(lambda *a: sp_update_kv_cache_at(
+            *a, layer, jnp.int32(pos), mesh))(kc, vc, kn, vn)
         np.testing.assert_array_equal(np.asarray(gk), np.asarray(ek))
         np.testing.assert_array_equal(np.asarray(gv), np.asarray(ev))
+
+    @needs_8
+    def test_multi_token_write_rejected(self):
+        from dllama_tpu.ops.sp_attention import sp_update_kv_cache_at
+
+        mesh = make_mesh(tp=2, sp=4, dp=1, devices=jax.devices()[:8])
+        kc = jnp.zeros((2, 1, 2, 32, 8), jnp.float32)
+        kn = jnp.zeros((1, 2, 3, 8), jnp.float32)  # T=3: would straddle shards
+        with pytest.raises(ValueError, match="one decode step"):
+            sp_update_kv_cache_at(kc, kc, kn, kn, jnp.int32(0), jnp.int32(0), mesh)
 
 
 class TestRing:

@@ -3,11 +3,17 @@
 Times the layer-stacked kernel (the decode hot path) on the llama2-7B
 matmul shapes for each (variant, tile_n, tile_d) configuration — each in a
 fresh subprocess because TILE_N governs the packed storage layout — and
-prints effective HBM bandwidth + a projected decode tok/s so the winning
-config can be made the default with evidence (VERDICT r02 Next #2).
+prints effective HBM bandwidth + a projected decode ms/token so the
+winning config can be made the default with evidence (VERDICT r02 Next #2).
+
+Measurement happens *inside one jitted ``lax.scan``* cycling the layer
+index, exactly like the decode loop runs the kernel: a host-side dispatch
+loop (the first version of this tool) measures tunnel/dispatch latency,
+not kernel time — same-config repeat runs varied ±30% where the scan
+timing is stable to a few percent and matches the xplane per-op numbers.
 
 Usage: python tools/sweep_q40.py            # sweep and rank
-       python tools/sweep_q40.py --one folded 1024 1024   # single config
+       python tools/sweep_q40.py --one folded 1024 2048   # single config
 """
 
 from __future__ import annotations
@@ -33,16 +39,23 @@ def shapes():
         ("wcls", 4096, 32000, 1),
     ]
 
+# (variant, tile_n, tile_d).  Wide tile_d configs probe DMA contiguity:
+# a (tn/2, td) tile of a row-major (n/2, d) plane is td contiguous bytes
+# per row, so td sets the HBM burst length (w13's d=22016 at td=1024 is
+# 1 KB bursts on a 22 KB stride).  tile_n below 256 is illegal (the
+# scales block spec needs tn/32 ≥ 8 sublanes).
 CONFIGS = [
-    ("classic", 1024, 1024), ("folded", 1024, 1024), ("exact", 1024, 1024),
-    ("classic", 512, 1024), ("folded", 512, 1024),
-    ("classic", 1024, 2048), ("folded", 1024, 2048),
-    ("classic", 2048, 1024), ("folded", 2048, 1024),
-    ("classic", 1024, 512), ("folded", 1024, 512),
+    ("classic", 1024, 1024), ("folded", 1024, 1024),
+    ("classic", 512, 2048), ("folded", 512, 2048),
+    ("classic", 256, 4096), ("folded", 256, 4096),
+    ("classic", 512, 4096),
+    ("classic", 256, 2048),
+    ("classic", 1024, 2048),
+    ("classic", 512, 1024),
 ]
 
 
-def measure_one(variant: str, reps: int = 30) -> dict:
+def measure_one(variant: str, reps: int = 64) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -61,20 +74,24 @@ def measure_one(variant: str, reps: int = 30) -> dict:
     for name, n, d, L in shapes():
         nb = n // 32
         qp = jnp.asarray(rng.randint(0, 256, (L, n // 2, d), dtype=np.uint8))
-        sc = jnp.asarray((rng.rand(L, nb, d).astype(np.float16) * 0.01))
+        sc = jnp.asarray((rng.rand(L, nb, d).astype(np.float16) * 0.01).view(np.uint16))
         x = jnp.asarray(rng.randn(1, n).astype(np.float32), jnp.bfloat16)
-        lidx = jnp.int32(0)
 
-        fn = lambda xx, l: q40._pallas_matmul_stacked(xx, qp, sc, l, variant=variant)
-        r = fn(x, lidx)
-        r.block_until_ready()
-        # cycle the layer index so HBM reads are not cache-resident
-        t0 = time.perf_counter()
-        for i in range(reps):
-            r = fn(x, jnp.int32(i % L))
-        r.block_until_ready()
+        # one compiled scan = `reps` serialized kernel calls cycling the
+        # layer index (scalar-prefetch path), exactly like decode's layer
+        # scan; the accumulator consumes each output so none is dead code
+        @jax.jit
+        def run(x, qp, sc):
+            def body(acc, i):
+                o = q40._pallas_matmul_stacked(x, qp, sc, i % L, variant=variant)
+                return acc + o.sum(), None
+            return jax.lax.scan(body, jnp.float32(0), jnp.arange(reps))[0]
+
+        float(run(x, qp, sc))  # compile + warmup (host copy: on the axon
+        t0 = time.perf_counter()  # tunnel block_until_ready doesn't block)
+        float(run(x, qp, sc))
         ms = (time.perf_counter() - t0) * 1000 / reps
-        nbytes = (n // 2) * d + nb * d * 2  # packed + f16 scales per layer
+        nbytes = (n // 2) * d + nb * d * 2  # packed + f16-bit scales per layer
         gbps = nbytes / ms / 1e6
         out["shapes"][name] = {"ms": round(ms, 4), "GBps": round(gbps, 1)}
         total_ms += ms * L
@@ -123,7 +140,7 @@ def main():
               f"@ {out['proj_matmul_GBps']:6.1f} GB/s", file=sys.stderr)
     results.sort(key=lambda r: r["proj_matmul_ms_per_token"])
     print("\n=== ranked ===", file=sys.stderr)
-    for r in results[:5]:
+    for r in results[:6]:
         print(f"{r['variant']:8s} tn={r['tile_n']:<5d} td={r['tile_d']:<5d} "
               f"{r['proj_matmul_ms_per_token']:7.2f} ms/tok "
               f"{r['proj_matmul_GBps']:6.1f} GB/s", file=sys.stderr)
