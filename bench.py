@@ -399,7 +399,6 @@ def main():
     probe = _spawn("probe", min(PROBE_TIMEOUT_S, max(remaining() - 420, 60)))
     on_hw = probe is not None and probe.get("platform") != "cpu"
 
-    hw_env = {}
     if on_hw:
         # kernel variant/tile choice is settled offline (tools/sweep_q40.py
         # + the xplane profile, docs/PERF.md): classic @ (1024, 1024) — an
@@ -412,7 +411,7 @@ def main():
             if budget < 180:
                 print("bench: budget exhausted, skipping to fallback", file=sys.stderr)
                 break
-            chunk_out = _spawn(name, min(budget, 900), env_extra=hw_env)
+            chunk_out = _spawn(name, min(budget, 900))
             if chunk_out:
                 break
         # the operator-surface run (synth .m → loader → Engine → CLI stats)
@@ -426,7 +425,7 @@ def main():
             # the grandchild CLI process is killed at an absolute deadline
             # strictly inside the attempt timeout, so a hang can never
             # orphan it on the TPU (synthesis time is inside the deadline)
-            cli_env = dict(hw_env)
+            cli_env = {}
             cli_env["BENCH_CLI_DEADLINE"] = str(time.time() + remaining() - 240)
             cli_out = _spawn("llama2-7b-cli", remaining() - 150, env_extra=cli_env)
         # packed-MoE decode on hardware once (VERDICT r02 Next #5): the
@@ -441,7 +440,7 @@ def main():
                     [sys.executable, os.path.join(here, "tools", "moe_hw_check.py"),
                      "--layers", "2", "--steps", "8"],
                     stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                    env=_child_env(hw_env), cwd=here,
+                    env=_child_env(), cwd=here,
                     timeout=min(remaining() - 60, 240))
                 tail = r.stdout.decode().strip().splitlines()[-1] if r.stdout else ""
                 print(f"bench: moe hw check rc={r.returncode}: {tail}",
@@ -453,7 +452,7 @@ def main():
         # prefix stays usable because attention reads O(pos) — stderr-only
         if chunk_out and "llama2-7b" in chunk_out.get("metric", "") \
                 and remaining() > 560:
-            long_out = _spawn("llama2-7b-long", 300, env_extra=hw_env)
+            long_out = _spawn("llama2-7b-long", 300)
             if long_out:
                 print(f"bench: long-context: {json.dumps(long_out)}",
                       file=sys.stderr)

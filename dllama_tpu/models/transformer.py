@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import q40
-from ..ops.attention import gqa_attention, update_kv_cache_at
+from ..ops.attention import gqa_attention_at, update_kv_cache_at
 from ..ops.kernels import ACTIVATIONS, apply_rope, rmsnorm, rope_angles, softmax_f32
 from ..ops.sp_attention import ring_attention, sp_gqa_attention, sp_update_kv_cache_at
 from ..parallel.mesh import get_active_mesh
@@ -110,14 +110,11 @@ def _attention_block(x, lp, cfg: ModelConfig, ck, cv, cos, sin, pos, layer):
             att = ring_attention(q, k, v, mesh, pos0=pos)
         else:
             # sequence-parallel decode / continuation: seq-sharded cache,
-            # one-round distributed softmax combine
-            k_l = jax.lax.dynamic_index_in_dim(ck, layer, 0, keepdims=False)
-            v_l = jax.lax.dynamic_index_in_dim(cv, layer, 0, keepdims=False)
-            att = sp_gqa_attention(q, k_l, v_l, pos, t, mesh)
+            # one-round distributed softmax combine; the layer is sliced
+            # inside the shard body (see sp_gqa_attention)
+            att = sp_gqa_attention(q, ck, cv, pos, t, mesh, layer=layer)
     else:
-        k_l = jax.lax.dynamic_index_in_dim(ck, layer, 0, keepdims=False)
-        v_l = jax.lax.dynamic_index_in_dim(cv, layer, 0, keepdims=False)
-        att = gqa_attention(q, k_l, v_l, pos, t)
+        att = gqa_attention_at(q, ck, cv, layer, pos, t)
     att = att.transpose(0, 2, 1, 3).reshape(b, t, hq * dh)
     out = _mm(att, lp["wo"], cfg, kind="col")  # col-sharded: partial sums all-reduced here
     return out, ck, cv

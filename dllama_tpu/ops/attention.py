@@ -131,7 +131,8 @@ _DECODE_BLOCKED_MIN_S = 4096
 
 
 def decode_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
-                         pos: jax.Array) -> jax.Array:
+                         pos: jax.Array,
+                         layer: jax.Array | None = None) -> jax.Array:
     """Single-token causal GQA that reads only blocks covering positions
     ``0..pos``.
 
@@ -143,15 +144,31 @@ def decode_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     ``lax.while_loop`` whose trip count is ``pos//block + 1``: each step
     dynamic-slices one KV block and folds it into the online-softmax
     accumulator, so traffic is proportional to the live prefix.
+
+    With ``layer`` the caches are the *stacked* (L, B, Hkv, S, Dh) buffers
+    and each block is sliced at ``(layer, ..., start, ...)`` directly —
+    slicing out the layer first would materialize the whole layer slab
+    (O(S) again, e.g. 128 MB per layer-step at 16k) before the loop reads
+    its first block.
     """
     b, hq, t, dh = q.shape
-    hkv = k_cache.shape[1]
-    s = k_cache.shape[2]
+    seq_ax = 2 if layer is None else 3
+    hkv = k_cache.shape[seq_ax - 1]
+    s = k_cache.shape[seq_ax]
     g = hq // hkv
     block = _kv_chunk(s)
     scale = 1.0 / jnp.sqrt(jnp.float32(dh))
     qf = q.astype(jnp.float32).reshape(b, hkv, g, t, dh)
     n_live = pos // block + 1
+
+    def slice_block(cache, start):
+        if layer is None:
+            return jax.lax.dynamic_slice_in_dim(cache, start, block, axis=2)
+        zero = jnp.zeros((), jnp.int32)
+        blk = jax.lax.dynamic_slice(
+            cache, (layer.astype(jnp.int32), zero, zero, start, zero),
+            (1, b, hkv, block, dh))
+        return blk[0]
 
     def cond(c):
         return c[0] < n_live
@@ -159,8 +176,8 @@ def decode_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     def body(c):
         i, m, l, acc = c
         start = i * block
-        kb = jax.lax.dynamic_slice_in_dim(k_cache, start, block, axis=2)
-        vb = jax.lax.dynamic_slice_in_dim(v_cache, start, block, axis=2)
+        kb = slice_block(k_cache, start)
+        vb = slice_block(v_cache, start)
         mask = ((start + jnp.arange(block)) <= pos)[None, :]  # (1=T, block)
         m, l, acc = _online_fold(qf, kb, vb, mask, m, l, acc, scale)
         return i + 1, m, l, acc
@@ -169,6 +186,25 @@ def decode_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         cond, body, (jnp.int32(0), *_fold_init(b, hkv, g, t, dh)))
     out = acc / jnp.maximum(l, 1e-38)[..., None]
     return out.reshape(b, hq, t, dh).astype(q.dtype)
+
+
+def gqa_attention_at(q: jax.Array, ck: jax.Array, cv: jax.Array,
+                     layer: jax.Array, pos: jax.Array, q_len: int) -> jax.Array:
+    """:func:`gqa_attention` over the *stacked* (L, B, Hkv, S, Dh) caches
+    at ``layer``.
+
+    The long-cache decode path slices its KV blocks straight out of the
+    stacked buffer (O(pos) traffic end to end); the short-cache and
+    prefill paths read the layer slice, which XLA fuses into the score
+    dot rather than materializing (observed in the 7B decode xplane).
+    """
+    t = q.shape[2]
+    s = ck.shape[3]
+    if t == 1 and s >= _DECODE_BLOCKED_MIN_S and _kv_chunk(s) < s:
+        return decode_gqa_attention(q, ck, cv, pos, layer=layer)
+    k_l = jax.lax.dynamic_index_in_dim(ck, layer, 0, keepdims=False)
+    v_l = jax.lax.dynamic_index_in_dim(cv, layer, 0, keepdims=False)
+    return gqa_attention(q, k_l, v_l, pos, q_len)
 
 
 def gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,

@@ -209,20 +209,34 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh,
 def sp_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                      pos: jax.Array, q_len: int, mesh,
                      q_spec: P = P("dp", "tp", None, None),
-                     kv_spec: P = P("dp", "tp", "sp", None)) -> jax.Array:
+                     kv_spec: P = P("dp", "tp", "sp", None),
+                     layer: jax.Array | None = None) -> jax.Array:
     """Causal GQA over a seq-sharded cache (drop-in for
     ops.attention.gqa_attention when the mesh has an ``sp`` axis).
 
     q: (B, Hq, T, Dh); k_cache/v_cache: (B, Hkv, S, Dh) with S sharded on
     ``sp``; returns (B, Hq, T, Dh) sharded like q.
+
+    With ``layer`` the caches are the stacked (L, B, Hkv, S, Dh) buffers
+    (``kv_spec`` must then carry the leading layer axis) and the layer is
+    sliced *inside* the shard body — slicing before the shard_map would
+    materialize the full layer slab per layer-step, since shard_map is a
+    fusion barrier (the same O(S) copy gqa_attention_at avoids on the
+    single-chip path).
     """
     b, hq, t, dh = q.shape
-    hkv = k_cache.shape[1]
+    seq_ax = 2 if layer is None else 3
+    hkv = k_cache.shape[seq_ax - 1]
     g = hq // hkv
     sp = mesh.shape.get("sp", 1)
-    chunk = k_cache.shape[2] // sp
+    chunk = k_cache.shape[seq_ax] // sp
+    if layer is not None:
+        kv_spec = P(None, *kv_spec)
 
     def shard_fn(q, k, v):
+        if layer is not None:
+            k = jax.lax.dynamic_index_in_dim(k, layer, 0, keepdims=False)
+            v = jax.lax.dynamic_index_in_dim(v, layer, 0, keepdims=False)
         # local shapes: q (b/dp, hq/tp, T, Dh), k/v (b/dp, hkv/tp, C, Dh)
         hq_l = q.shape[1]
         hkv_l = k.shape[1]

@@ -126,3 +126,23 @@ def test_gqa_dispatches_decode_blocked_for_long_cache():
     got = attention.gqa_attention(q, k, v, jnp.int32(77), 1)
     ref = attention.decode_gqa_attention(q, k, v, jnp.int32(77))
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_stacked_decode_blocked_matches_per_layer():
+    """gqa_attention_at over a long stacked cache (blocks sliced straight
+    from the 5-D buffer — no layer-slab materialization) must equal the
+    per-layer length-aware path on that layer's slice."""
+    from dllama_tpu.ops import attention
+
+    r = np.random.RandomState(3)
+    L, b, hq, hkv, s, dh = 3, 1, 4, 2, 4096, 8
+    q = jnp.asarray(r.randn(b, hq, 1, dh), jnp.float32)
+    ck = jnp.asarray(r.randn(L, b, hkv, s, dh), jnp.float32)
+    cv = jnp.asarray(r.randn(L, b, hkv, s, dh), jnp.float32)
+    for layer in range(L):
+        for pos in (0, 1023, 1024, s - 1):
+            got = attention.gqa_attention_at(
+                q, ck, cv, jnp.int32(layer), jnp.int32(pos), 1)
+            ref = attention.decode_gqa_attention(
+                q, ck[layer], cv[layer], jnp.int32(pos))
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
