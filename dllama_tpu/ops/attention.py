@@ -130,6 +130,15 @@ def blocked_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 _DECODE_BLOCKED_MIN_S = 4096
 
 
+def _use_blocked_decode(t: int, s: int) -> bool:
+    """Shared dispatch predicate for the length-aware decode path, so the
+    stacked-cache and per-layer entry points can never diverge on which
+    attention algorithm serves the same shapes.  ``_kv_chunk(s) == s``
+    would be one loop step over the whole cache: all the loop overhead,
+    none of the O(pos) traffic win."""
+    return t == 1 and s >= _DECODE_BLOCKED_MIN_S and _kv_chunk(s) < s
+
+
 def decode_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                          pos: jax.Array,
                          layer: jax.Array | None = None) -> jax.Array:
@@ -200,7 +209,7 @@ def gqa_attention_at(q: jax.Array, ck: jax.Array, cv: jax.Array,
     """
     t = q.shape[2]
     s = ck.shape[3]
-    if t == 1 and s >= _DECODE_BLOCKED_MIN_S and _kv_chunk(s) < s:
+    if _use_blocked_decode(t, s):
         return decode_gqa_attention(q, ck, cv, pos, layer=layer)
     k_l = jax.lax.dynamic_index_in_dim(ck, layer, 0, keepdims=False)
     v_l = jax.lax.dynamic_index_in_dim(cv, layer, 0, keepdims=False)
@@ -233,9 +242,7 @@ def gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 
     if t > 1 and g * t * s > _BLOCKED_THRESHOLD:
         return blocked_gqa_attention(q, k_cache, v_cache, pos, q_len)
-    if t == 1 and s >= _DECODE_BLOCKED_MIN_S and _kv_chunk(s) < s:
-        # _kv_chunk(s) == s would be one loop step over the whole cache:
-        # all the loop overhead, none of the O(pos) traffic win
+    if _use_blocked_decode(t, s):
         return decode_gqa_attention(q, k_cache, v_cache, pos)
 
     # operands in cache dtype, f32 accumulation — see _online_fold for why

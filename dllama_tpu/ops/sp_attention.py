@@ -217,12 +217,12 @@ def sp_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     q: (B, Hq, T, Dh); k_cache/v_cache: (B, Hkv, S, Dh) with S sharded on
     ``sp``; returns (B, Hq, T, Dh) sharded like q.
 
-    With ``layer`` the caches are the stacked (L, B, Hkv, S, Dh) buffers
-    (``kv_spec`` must then carry the leading layer axis) and the layer is
-    sliced *inside* the shard body — slicing before the shard_map would
-    materialize the full layer slab per layer-step, since shard_map is a
-    fusion barrier (the same O(S) copy gqa_attention_at avoids on the
-    single-chip path).
+    With ``layer`` the caches are the stacked (L, B, Hkv, S, Dh) buffers —
+    ``kv_spec`` stays the per-layer 4-axis spec and the unsharded layer
+    axis is prepended here — and the layer is sliced *inside* the shard
+    body: slicing before the shard_map would materialize the full layer
+    slab per layer-step, since shard_map is a fusion barrier (the same
+    O(S) copy gqa_attention_at avoids on the single-chip path).
     """
     b, hq, t, dh = q.shape
     seq_ax = 2 if layer is None else 3
