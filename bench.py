@@ -209,21 +209,43 @@ def _child_env(extra: dict | None = None) -> dict:
 
 def _profile_split_stderr(run_once, chunk):
     """Trace one decode chunk and log the compute/collective split — the
-    reference's I/T attribution on a real TPU xplane (VERDICT r02 Next #4)."""
+    reference's I/T attribution on a real TPU xplane (VERDICT r02 Next #4) —
+    plus the top per-op device times, so every driver-captured bench run
+    records where the step time actually goes."""
     try:
-        from dllama_tpu.runtime.profiling import profiled_split
+        import glob
+        import tempfile
 
-        split = profiled_split(run_once, steps=1)
-        if split is None:
-            print("bench: profile split unavailable (no xplane tooling/trace)",
+        import jax
+        from dllama_tpu.runtime.profiling import op_times
+
+        with tempfile.TemporaryDirectory() as d:
+            jax.profiler.start_trace(d)
+            try:
+                run_once()
+            finally:
+                jax.profiler.stop_trace()
+            if not glob.glob(d + "/**/*.xplane.pb", recursive=True):
+                print("bench: profile split unavailable (no xplane produced)",
+                      file=sys.stderr)
+                return
+            times = op_times(d)
+        if not times:
+            print("bench: profile split unavailable (no device op events)",
                   file=sys.stderr)
             return
-        comp, coll = split["compute_ms"], split["collective_ms"]
+        from dllama_tpu.runtime.profiling import _COLLECTIVE
+
+        comp = sum(ms for op, ms in times.items() if not _COLLECTIVE.search(op))
+        coll = sum(ms for op, ms in times.items() if _COLLECTIVE.search(op))
         verdict = ("T≈0 contract holds" if coll < 1.0
-                   else f"collectives are {split['collective_pct']:.1f}% — inspect")
+                   else f"collectives are {100 * coll / (comp + coll):.1f}% — inspect")
         print(f"bench: profile split over {chunk}-token chunk: "
               f"compute {comp:.1f} ms, collectives {coll:.1f} ms "
               f"({comp / chunk:.2f} ms/token compute; {verdict})", file=sys.stderr)
+        top = sorted(times.items(), key=lambda kv: -kv[1])[:6]
+        for op, ms in top:
+            print(f"bench:   top op {ms:8.2f} ms  {op}", file=sys.stderr)
     except Exception as e:
         print(f"bench: profile split failed ({type(e).__name__}: {str(e)[:120]})",
               file=sys.stderr)
