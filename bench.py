@@ -213,31 +213,14 @@ def _profile_split_stderr(run_once, chunk):
     plus the top per-op device times, so every driver-captured bench run
     records where the step time actually goes."""
     try:
-        import glob
-        import tempfile
+        from dllama_tpu.runtime.profiling import split_op_times, traced_op_times
 
-        import jax
-        from dllama_tpu.runtime.profiling import op_times
-
-        with tempfile.TemporaryDirectory() as d:
-            jax.profiler.start_trace(d)
-            try:
-                run_once()
-            finally:
-                jax.profiler.stop_trace()
-            if not glob.glob(d + "/**/*.xplane.pb", recursive=True):
-                print("bench: profile split unavailable (no xplane produced)",
-                      file=sys.stderr)
-                return
-            times = op_times(d)
+        times = traced_op_times(run_once, steps=1)
         if not times:
-            print("bench: profile split unavailable (no device op events)",
+            print("bench: profile split unavailable (no xplane tooling/trace)",
                   file=sys.stderr)
             return
-        from dllama_tpu.runtime.profiling import _COLLECTIVE
-
-        comp = sum(ms for op, ms in times.items() if not _COLLECTIVE.search(op))
-        coll = sum(ms for op, ms in times.items() if _COLLECTIVE.search(op))
+        comp, coll = split_op_times(times)
         verdict = ("T≈0 contract holds" if coll < 1.0
                    else f"collectives are {100 * coll / (comp + coll):.1f}% — inspect")
         print(f"bench: profile split over {chunk}-token chunk: "

@@ -37,7 +37,6 @@ from .models.params import load_params
 from .parallel.mesh import parse_workers
 from .runtime.engine import Engine, RunStats
 from .runtime.stream import drain_generation
-from .sampling import Sampler
 from .tokenizer.bpe import Tokenizer
 from .tokenizer.chat import ChatItem, ChatTemplate, TokenizerChatStops
 from .tokenizer.eos import EosDetector

@@ -31,7 +31,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
 from ..models.params import Params
-from ..models.transformer import KVCache, forward_last, init_kv_cache
+from ..models.transformer import forward_last, init_kv_cache
 from ..parallel import sharding
 from ..parallel.mesh import active_mesh, make_mesh
 from ..sampling import Sampler

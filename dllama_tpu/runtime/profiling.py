@@ -93,14 +93,11 @@ def _parse_xspace(path: str) -> tuple[float, float]:
     return compute_ps / 1e9, collective_ps / 1e9
 
 
-def profiled_split(step: Callable[[], None], steps: int = 3) -> dict | None:
-    """Trace ``steps`` calls of ``step()`` and attribute device-op time.
-
-    Returns ``{"compute_ms", "collective_ms", "collective_pct"}`` with the
-    ms values per step summed across every device in the mesh (divide by
-    the device count for a per-chip figure), or ``None`` when the xplane
-    proto tooling is unavailable.
-    """
+def traced_op_times(step: Callable[[], None], steps: int = 1) -> dict[str, float] | None:
+    """Trace ``steps`` calls of ``step()`` and return per-op device time
+    (ms, summed over the calls and over every device in the mesh), or
+    ``None`` when the xplane proto tooling is unavailable or the backend
+    produced no trace files."""
     try:
         from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: F401
     except Exception:
@@ -117,7 +114,30 @@ def profiled_split(step: Callable[[], None], steps: int = 3) -> dict | None:
         files = glob.glob(d + "/**/*.xplane.pb", recursive=True)
         if not files:
             return None
-        compute_ms, collective_ms = map(sum, zip(*(map(_parse_xspace, files))))
+        return op_times(d)
+
+
+def split_op_times(times: dict[str, float]) -> tuple[float, float]:
+    """Classify per-op times into (compute_ms, collective_ms) — the single
+    home of the I/T classification used by both the CLI --profile-split
+    and the bench's profile stage."""
+    compute = sum(ms for op, ms in times.items() if not _COLLECTIVE.search(op))
+    collective = sum(ms for op, ms in times.items() if _COLLECTIVE.search(op))
+    return compute, collective
+
+
+def profiled_split(step: Callable[[], None], steps: int = 3) -> dict | None:
+    """Trace ``steps`` calls of ``step()`` and attribute device-op time.
+
+    Returns ``{"compute_ms", "collective_ms", "collective_pct"}`` with the
+    ms values per step summed across every device in the mesh (divide by
+    the device count for a per-chip figure), or ``None`` when the xplane
+    proto tooling is unavailable.
+    """
+    times = traced_op_times(step, steps)
+    if times is None:
+        return None
+    compute_ms, collective_ms = split_op_times(times)
     compute_ms /= steps
     collective_ms /= steps
     total = compute_ms + collective_ms

@@ -10,7 +10,6 @@ collectives).
 
 from __future__ import annotations
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp

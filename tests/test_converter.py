@@ -17,7 +17,6 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(
 
 from dllama_tpu import quants
 from dllama_tpu.io import mfile, tfile
-from dllama_tpu.models.config import ModelConfig
 from dllama_tpu.models.params import load_params
 
 

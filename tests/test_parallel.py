@@ -8,7 +8,6 @@ the same logits and the same greedy tokens."""
 import numpy as np
 import pytest
 import jax
-import jax.numpy as jnp
 
 from dllama_tpu.models.config import tiny_config
 from dllama_tpu.models.params import init_params

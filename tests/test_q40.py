@@ -336,3 +336,15 @@ class TestEngineIntegration:
         # keep=False dequantizes the same Q40 bytes → same values → greedy
         # decode must match exactly
         assert outs[0] == outs[1]
+
+
+def test_f16_bits_to_f32_exhaustive():
+    """The in-kernel integer widening must agree with IEEE f16→f32 for
+    every finite bit pattern (the codec never stores inf/nan scales) —
+    this is what keeps dequantization bit-identical to the file format
+    with uint16-stored scales."""
+    bits = np.arange(1 << 16, dtype=np.uint16)
+    finite = np.isfinite(bits.view(np.float16))
+    got = np.asarray(q40._f16_bits_to_f32(jnp.asarray(bits[finite])))
+    exp = bits[finite].view(np.float16).astype(np.float32)
+    np.testing.assert_array_equal(got, exp)
