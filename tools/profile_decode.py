@@ -14,7 +14,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -61,13 +60,20 @@ def main():
     print(f"untraced chunk: {wall_ms:.1f} ms = {wall_ms / chunk:.2f} ms/token "
           f"({1000 * chunk / wall_ms:.1f} tok/s)")
 
-    with tempfile.TemporaryDirectory() as d:
-        jax.profiler.start_trace(d)
-        toks, cache, tok, _, _ = fn(params, cache, tok, jnp.int32(2 * chunk), key)
+    from dllama_tpu.runtime.profiling import traced_op_times
+
+    state = {"cache": cache, "tok": tok, "pos": 2 * chunk}
+
+    def traced_step():
+        toks, state["cache"], state["tok"], _, _ = fn(
+            params, state["cache"], state["tok"], jnp.int32(state["pos"]), key)
+        state["pos"] += chunk
         np.asarray(toks)
-        jax.profiler.stop_trace()
-        from dllama_tpu.runtime.profiling import op_times
-        times = op_times(d)
+
+    times = traced_op_times(traced_step, steps=1)
+    if times is None:
+        print("no xplane tooling/trace available", file=sys.stderr)
+        return
 
     total = sum(times.values())
     print(f"\ndevice op time: {total:.1f} ms over {chunk} steps "
