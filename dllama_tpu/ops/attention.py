@@ -132,11 +132,49 @@ _DECODE_BLOCKED_MIN_S = 4096
 
 def _use_blocked_decode(t: int, s: int) -> bool:
     """Shared dispatch predicate for the length-aware decode path, so the
-    stacked-cache and per-layer entry points can never diverge on which
-    attention algorithm serves the same shapes.  ``_kv_chunk(s) == s``
-    would be one loop step over the whole cache: all the loop overhead,
-    none of the O(pos) traffic win."""
+    stacked-cache, per-layer, and sequence-parallel entry points can never
+    diverge on which attention algorithm serves the same shapes.
+    ``_kv_chunk(s) == s`` would be one loop step over the whole cache: all
+    the loop overhead, none of the O(pos) traffic win."""
     return t == 1 and s >= _DECODE_BLOCKED_MIN_S and _kv_chunk(s) < s
+
+
+def blocked_live_fold(qf, slice_block, k_cache, v_cache, pos, base, c,
+                      wrap=lambda x: x):
+    """The length-aware online-softmax core: walk only the KV blocks of a
+    chunk of length ``c`` (global position offset ``base``) that cover
+    live positions ≤ ``pos``, folding each into the running (max, denom,
+    numerator).  Shared by :func:`decode_gqa_attention` (base 0, whole
+    cache) and the sequence-parallel per-shard partials (base = the
+    shard's chunk start) so the block walk cannot drift between them.
+
+    ``slice_block(cache, start, length)`` cuts one (B, Hkv, length, Dh)
+    block; ``wrap`` marks fresh accumulators (shard_map bodies pass a
+    device-varying cast).  Returns raw ``(m, l, acc)`` — callers gated on
+    a non-empty live region fold at least one block, so ``m`` is a real
+    max.  The caller normalizes (``acc / l``) or combines partials."""
+    b, hkv, g, t, dh = qf.shape
+    block = _kv_chunk(c)
+    scale = 1.0 / jnp.sqrt(jnp.float32(dh))
+    local_last = jnp.clip(pos - base, 0, c - 1)
+    n_live = local_last // block + 1
+
+    def cond(carry):
+        return carry[0] < n_live
+
+    def body(carry):
+        i, m, l, acc = carry
+        start = i * block
+        kb = slice_block(k_cache, start, block)
+        vb = slice_block(v_cache, start, block)
+        mask = ((base + start + jnp.arange(block)) <= pos)[None, :]
+        m, l, acc = _online_fold(qf, kb, vb, mask, m, l, acc, scale)
+        return i + 1, m, l, acc
+
+    m0, l0, acc0 = _fold_init(b, hkv, g, t, dh)
+    init = (jnp.int32(0), wrap(m0), wrap(l0), wrap(acc0))
+    _, m, l, acc = jax.lax.while_loop(cond, body, init)
+    return m, l, acc
 
 
 def decode_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
@@ -165,34 +203,19 @@ def decode_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     hkv = k_cache.shape[seq_ax - 1]
     s = k_cache.shape[seq_ax]
     g = hq // hkv
-    block = _kv_chunk(s)
-    scale = 1.0 / jnp.sqrt(jnp.float32(dh))
     qf = q.astype(jnp.float32).reshape(b, hkv, g, t, dh)
-    n_live = pos // block + 1
 
-    def slice_block(cache, start):
+    def slice_block(cache, start, length):
         if layer is None:
-            return jax.lax.dynamic_slice_in_dim(cache, start, block, axis=2)
+            return jax.lax.dynamic_slice_in_dim(cache, start, length, axis=2)
         zero = jnp.zeros((), jnp.int32)
         blk = jax.lax.dynamic_slice(
             cache, (layer.astype(jnp.int32), zero, zero, start, zero),
-            (1, b, hkv, block, dh))
+            (1, b, hkv, length, dh))
         return blk[0]
 
-    def cond(c):
-        return c[0] < n_live
-
-    def body(c):
-        i, m, l, acc = c
-        start = i * block
-        kb = slice_block(k_cache, start)
-        vb = slice_block(v_cache, start)
-        mask = ((start + jnp.arange(block)) <= pos)[None, :]  # (1=T, block)
-        m, l, acc = _online_fold(qf, kb, vb, mask, m, l, acc, scale)
-        return i + 1, m, l, acc
-
-    _, _, l, acc = jax.lax.while_loop(
-        cond, body, (jnp.int32(0), *_fold_init(b, hkv, g, t, dh)))
+    _, l, acc = blocked_live_fold(qf, slice_block, k_cache, v_cache, pos,
+                                  jnp.int32(0), s)
     out = acc / jnp.maximum(l, 1e-38)[..., None]
     return out.reshape(b, hq, t, dh).astype(q.dtype)
 

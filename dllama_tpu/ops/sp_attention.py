@@ -124,6 +124,28 @@ def _local_partials(q, k, v, pos, q_len, chunk_start):
     return o_i, l_i, m_i
 
 
+def _local_partials_blocked(q, k, v, pos, chunk_start):
+    """Decode-step (T==1) per-shard partials that read only the KV blocks
+    covering this shard's *live* positions — the within-shard analogue of
+    ops.attention.decode_gqa_attention (same shared block-walk core), so
+    sp long-context decode is O(live prefix) per shard instead of
+    O(chunk): at 128k context over sp=8, a shard whose live region is 4k
+    reads 4k positions, not its whole 16k chunk.  Produces the same
+    (o_i, l_i, m_i) convention as :func:`_local_partials` (the caller
+    gates on a non-empty live region, so at least one block folds and
+    ``m_i`` is a real max)."""
+    from .attention import blocked_live_fold
+
+    def slice_block(cache, start, length):
+        return jax.lax.dynamic_slice_in_dim(cache, start, length, axis=2)
+
+    # accumulators marked device-varying so the while_loop carry type
+    # matches the body's shard-varying values (same trick as _empty_partials)
+    m, l, acc = blocked_live_fold(q, slice_block, k, v, pos, chunk_start,
+                                  k.shape[2], wrap=_varying)
+    return acc, l, m
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh,
                    pos0: jax.Array | int = 0,
                    q_spec: P = P("dp", "tp", "sp", None),
@@ -243,7 +265,13 @@ def sp_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         qf = q.astype(jnp.float32).reshape(q.shape[0], hkv_l, hq_l // hkv_l, t, dh)
         chunk_start = jax.lax.axis_index("sp") * chunk
 
+        from .attention import _use_blocked_decode
+
         def compute(_):
+            # decode over a long local chunk: walk only the blocks covering
+            # this shard's live positions (O(live) per shard, not O(chunk))
+            if _use_blocked_decode(q_len, chunk):
+                return _local_partials_blocked(qf, k, v, pos, chunk_start)
             return _local_partials(qf, k, v, pos, q_len, chunk_start)
 
         def empty(_):

@@ -52,6 +52,23 @@ class TestOp:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)
 
+
+    @needs_8
+    @pytest.mark.parametrize("pos", [0, 100, 4095, 8191, 16383])
+    def test_blocked_long_chunk_matches_dense(self, pos):
+        """Long local chunks (>= the blocked-decode threshold) walk only
+        live KV blocks per shard; results must equal dense one-shot
+        attention at every position class, including block boundaries."""
+        from dllama_tpu.ops import attention
+
+        mesh = make_mesh(tp=1, sp=4, dp=1, devices=jax.devices()[:4])
+        q, k, v = _qkv(s=16384, t=1)   # local chunk 4096 -> blocked path
+        ref = gqa_attention(q, k, v, jnp.int32(pos), 1)
+        out = jax.jit(lambda q, k, v: sp_gqa_attention(
+            q, k, v, jnp.int32(pos), 1, mesh))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
     @needs_8
     def test_empty_shards_no_nan(self):
         """pos=0: only shard 0 has any unmasked keys; others must
