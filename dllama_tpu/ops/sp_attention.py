@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from .attention import _use_blocked_decode, blocked_live_fold
+
 NEG_BIG = -1e30  # stand-in for -inf that keeps exp() NaN-free on empty shards
 
 
@@ -134,8 +136,6 @@ def _local_partials_blocked(q, k, v, pos, chunk_start):
     (o_i, l_i, m_i) convention as :func:`_local_partials` (the caller
     gates on a non-empty live region, so at least one block folds and
     ``m_i`` is a real max)."""
-    from .attention import blocked_live_fold
-
     def slice_block(cache, start, length):
         return jax.lax.dynamic_slice_in_dim(cache, start, length, axis=2)
 
@@ -264,8 +264,6 @@ def sp_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         hkv_l = k.shape[1]
         qf = q.astype(jnp.float32).reshape(q.shape[0], hkv_l, hq_l // hkv_l, t, dh)
         chunk_start = jax.lax.axis_index("sp") * chunk
-
-        from .attention import _use_blocked_decode
 
         def compute(_):
             # decode over a long local chunk: walk only the blocks covering

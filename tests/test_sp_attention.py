@@ -207,6 +207,25 @@ class TestModel:
         assert ref == got
 
     @needs_8
+    def test_engine_sp_long_cache_blocked_decode(self):
+        """Engine decode over an sp mesh whose local chunk crosses the
+        blocked-decode threshold (seq 16384 / sp 4 = 4096): the per-shard
+        live-prefix block walk must reproduce single-device greedy tokens
+        end to end."""
+        cfg = tiny_config(dim=32, hidden_dim=64, n_layers=2, n_heads=4,
+                          n_kv_heads=2, vocab_size=64, seq_len=16384)
+        params = init_params(cfg, seed=3)
+
+        def toks(engine):
+            s = Sampler(cfg.vocab_size, 0.0, 0.9, 0)
+            return [t for t, _ in engine.generate([5, 9, 2], steps=8, sampler=s)]
+
+        ref = toks(Engine(cfg, params))
+        mesh = make_mesh(tp=1, sp=4, dp=1, devices=jax.devices()[:4])
+        got = toks(Engine(cfg, params, mesh=mesh))
+        assert ref == got
+
+    @needs_8
     def test_engine_ring_prefill_equivalence(self):
         """A long from-scratch prompt on an sp mesh takes the ring-prefill
         path (sequence-sharded tokens, blockwise attention) and still
