@@ -465,9 +465,11 @@ def main():
                 print(f"bench: moe hw check failed ({type(e).__name__})",
                       file=sys.stderr)
         # long-context decode evidence: 16k cache, decode deep in a live
-        # prefix stays usable because attention reads O(pos) — stderr-only
+        # prefix stays usable because attention reads O(pos) — stderr-only.
+        # Same gate as the llama3 stage below: this one runs first because
+        # long context is the flagship beyond-reference capability.
         if chunk_out and "llama2-7b" in chunk_out.get("metric", "") \
-                and remaining() > 560:
+                and remaining() > 460:
             long_out = _spawn("llama2-7b-long", 300)
             if long_out:
                 print(f"bench: long-context: {json.dumps(long_out)}",
