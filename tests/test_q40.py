@@ -93,6 +93,16 @@ class TestMatmul:
             np.testing.assert_allclose(out, ref3, rtol=0,
                                        atol=2e-2 * np.abs(ref3).max())
 
+    @pytest.mark.parametrize("variant", ["classic", "folded", "exact"])
+    def test_kernel_multirow_prefill_chunk(self, variant):
+        """Prefill-sized inputs (t=8 rows, under PALLAS_MAX_ROWS) through
+        every dequant variant — the multi-row path the auto dispatch uses
+        for short prefills."""
+        x, qt, ref = self._setup(t=8, n=2048, d=256)
+        out = np.asarray(q40._pallas_matmul(
+            jnp.asarray(x), qt.qpacked, qt.scales, interpret=True, variant=variant))
+        np.testing.assert_allclose(out, ref, rtol=0, atol=2e-2 * np.abs(ref).max())
+
     def test_pallas_interpret_ragged_d(self):
         """Output dim not divisible by the tile: ragged last tile masked."""
         x, qt, ref = self._setup(t=1, n=1024, d=1024 + 384)
