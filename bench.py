@@ -482,6 +482,26 @@ def main():
             if l3_out:
                 print(f"bench: north-star config: {json.dumps(l3_out)}",
                       file=sys.stderr)
+        # tile probe: the #1 open perf question (docs/PERF.md) is whether a
+        # wider tile_d lifts the wide-output shapes' DMA rate; time just the
+        # w13 shape at the default and the hypothesis config so the answer
+        # lands in every driver log — one remote compile per config
+        if chunk_out and remaining() > 500:
+            here = os.path.dirname(os.path.abspath(__file__))
+            for tn, td in ((1024, 1024), (512, 2048)):
+                if remaining() < 150:
+                    break
+                try:
+                    r = subprocess.run(
+                        [sys.executable, os.path.join(here, "tools", "sweep_q40.py"),
+                         "--one", "classic", str(tn), str(td), "--shapes", "w13"],
+                        stdout=subprocess.PIPE, env=_child_env(), cwd=here,
+                        timeout=min(remaining() - 60, 240))
+                    line = r.stdout.decode().strip().splitlines()[-1] if r.stdout else ""
+                    print(f"bench: tile probe ({tn},{td}): {line}", file=sys.stderr)
+                except Exception as e:
+                    print(f"bench: tile probe ({tn},{td}) failed "
+                          f"({type(e).__name__})", file=sys.stderr)
         if cli_out:
             print(f"bench: decode_chunk cross-check: {json.dumps(chunk_out)}",
                   file=sys.stderr)

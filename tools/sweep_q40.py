@@ -57,7 +57,10 @@ CONFIGS = [
 ]
 
 
-def measure_one(variant: str, reps: int = 32) -> dict:
+def measure_one(variant: str, reps: int = 32, only: set | None = None) -> dict:
+    """Time the stacked kernel on the 7B shapes (or the ``only`` subset —
+    a single-shape run is ~one remote compile, cheap enough for the bench
+    to probe tile configs inline)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -74,6 +77,8 @@ def measure_one(variant: str, reps: int = 32) -> dict:
     total_ms = 0.0
     total_bytes = 0
     for name, n, d, L in shapes():
+        if only and name not in only:
+            continue
         nb = n // 32
         qp = jnp.asarray(rng.randint(0, 256, (L, n // 2, d), dtype=np.uint8))
         sc = jnp.asarray((rng.rand(L, nb, d).astype(np.float16) * 0.01).view(np.uint16))
@@ -98,29 +103,37 @@ def measure_one(variant: str, reps: int = 32) -> dict:
         out["shapes"][name] = {"ms": round(ms, 4), "GBps": round(gbps, 1)}
         total_ms += ms * L
         total_bytes += nbytes * L
-    # unmeasured 7B shapes, projected at a measured peer's rate; the rate
-    # class tracks *output width d* (= DMA row stride, docs/PERF.md): w2
-    # (d=4096) matches wo's class, wcls (d=32000) extrapolates wqkv/w13's
-    per_w = 0.5 + 2 / 32  # packed + f16-bit scale bytes per weight
-    for nbytes, peer in ((int(11264 * 4096 * per_w) * 32, "wo"),
-                         (int(4096 * 32000 * per_w), "w13")):
-        gbps = out["shapes"][peer]["GBps"]
-        total_ms += nbytes / gbps / 1e6
-        total_bytes += nbytes
-    out["proj_matmul_ms_per_token"] = round(total_ms, 3)
-    out["proj_matmul_GBps"] = round(total_bytes / total_ms / 1e6, 1)
+    if not only:
+        # unmeasured 7B shapes, projected at a measured peer's rate; the
+        # rate class tracks *output width d* (= DMA row stride,
+        # docs/PERF.md): w2 (d=4096) matches wo's class, wcls (d=32000)
+        # extrapolates wqkv/w13's
+        per_w = 0.5 + 2 / 32  # packed + f16-bit scale bytes per weight
+        for nbytes, peer in ((int(11264 * 4096 * per_w) * 32, "wo"),
+                             (int(4096 * 32000 * per_w), "w13")):
+            gbps = out["shapes"][peer]["GBps"]
+            total_ms += nbytes / gbps / 1e6
+            total_bytes += nbytes
+        out["proj_matmul_ms_per_token"] = round(total_ms, 3)
+        out["proj_matmul_GBps"] = round(total_bytes / total_ms / 1e6, 1)
     print(json.dumps(out))
     return out
 
 
 def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--one":
-        if len(sys.argv) > 4:
+        argv = sys.argv[2:]
+        only = None
+        if "--shapes" in argv:
+            i = argv.index("--shapes")
+            only = set(argv[i + 1].split(","))
+            argv = argv[:i] + argv[i + 2:]
+        if len(argv) > 2:
             # tiles must be in the env before the q40 import inside
             # measure_one reads them
-            os.environ["DLLAMA_Q40_TILE_N"] = sys.argv[3]
-            os.environ["DLLAMA_Q40_TILE_D"] = sys.argv[4]
-        measure_one(sys.argv[2])
+            os.environ["DLLAMA_Q40_TILE_N"] = argv[1]
+            os.environ["DLLAMA_Q40_TILE_D"] = argv[2]
+        measure_one(argv[0], only=only)
         return
     results = []
     for variant, tn, td in CONFIGS:
