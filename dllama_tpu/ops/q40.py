@@ -72,7 +72,7 @@ TILE_D = int(os.environ.get("DLLAMA_Q40_TILE_D", "1024"))
 # Decode uses the Pallas kernel; past this many rows the matmul is MXU-bound
 # and the XLA path (which can pipeline the dequant) is preferable.
 PALLAS_MAX_ROWS = 128
-# Kernel dequant variant (see _q40_kernel): classic | folded | exact.
+# Kernel dequant variant (see _q40_kernel): classic | fma | folded | exact.
 KERNEL_VARIANT = os.environ.get("DLLAMA_Q40_VARIANT", "classic")
 
 
@@ -296,12 +296,17 @@ def _q40_kernel(xlo_ref, xhi_ref, bsum_ref, qp_ref, s_ref, o_ref, acc_ref, *,
     The lo/hi nibble planes are contracted by two separate dots against the
     matching halves of x (prepared outside the kernel, where XLA fuses the
     splits), which avoids a concat-to-logical-order relayout.  VPU unpack
-    work is the decode bottleneck after DMA, so three ``variant`` trade-offs
+    work is the decode bottleneck after DMA, so four ``variant`` trade-offs
     exist between per-weight VPU ops and rounding:
 
     * ``classic`` — ``bf16(f32(v−8)·s)`` per weight: the reference's
       dequantization rounding (one bf16 round of the exact product,
       funcs.cpp:330-335 semantics); ~5.5 VPU ops/weight.
+    * ``fma``     — same f32 math regrouped as ``v·s + (−8·s)`` with the
+      per-block ``−8·s`` computed once per (block, column): saves the
+      per-weight subtract if the backend emits a fused multiply-add
+      (~4.5 VPU ops/weight); identical result up to one f32 rounding
+      regrouping, same single bf16 round as classic.
     * ``folded``  — the −8 bias never touches the weights: with
       ``w=(v−8)·s``, ``x·w = x·(v·s) − 8·(Σ_block x)·s``, so the kernel
       feeds the MXU ``bf16(v)·bf16(s)`` and corrects with a per-block dot
@@ -355,6 +360,13 @@ def _q40_kernel(xlo_ref, xhi_ref, bsum_ref, qp_ref, s_ref, o_ref, acc_ref, *,
             hi = ((vi >> 4).astype(jnp.float32) - 8.0).reshape(nb, 16, td)
             lo = (lo * s32[:, None, :]).astype(jnp.bfloat16).reshape(tn2, td)
             hi = (hi * s32[:, None, :]).astype(jnp.bfloat16).reshape(tn2, td)
+            bias = 0.0
+        elif variant == "fma":
+            m32 = -8.0 * s32                              # (nb, td), amortized /16
+            lo = (vi & 0xF).astype(jnp.float32).reshape(nb, 16, td)
+            hi = (vi >> 4).astype(jnp.float32).reshape(nb, 16, td)
+            lo = (lo * s32[:, None, :] + m32[:, None, :]).astype(jnp.bfloat16).reshape(tn2, td)
+            hi = (hi * s32[:, None, :] + m32[:, None, :]).astype(jnp.bfloat16).reshape(tn2, td)
             bias = 0.0
         else:  # folded
             sb = s32.astype(jnp.bfloat16)
@@ -412,9 +424,9 @@ def _bsum_mat(tile_n: int) -> np.ndarray:
 
 def _check_variant(variant: str | None) -> str:
     v = variant or KERNEL_VARIANT
-    if v not in ("classic", "folded", "exact"):
+    if v not in ("classic", "fma", "folded", "exact"):
         raise ValueError(f"unknown q40 kernel variant {v!r} "
-                         "(expected classic | folded | exact)")
+                         "(expected classic | fma | folded | exact)")
     return v
 
 

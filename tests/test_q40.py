@@ -73,9 +73,9 @@ class TestMatmul:
         out_p = np.asarray(q40.matmul(jnp.asarray(x), qt, impl="pallas_interpret"))
         np.testing.assert_allclose(out_p, ref, rtol=0, atol=2e-2 * np.abs(ref).max())
 
-    @pytest.mark.parametrize("variant", ["classic", "folded", "exact"])
+    @pytest.mark.parametrize("variant", ["classic", "fma", "folded", "exact"])
     def test_kernel_variants_match_xla(self, variant):
-        """All three dequant variants (see _q40_kernel) compute the same
+        """All dequant variants (see _q40_kernel) compute the same
         matmul within their documented rounding bounds, flat and stacked."""
         x, qt, ref = self._setup(t=1, n=1024, d=256)
         tol = 2e-2 * np.abs(ref).max()
@@ -93,7 +93,7 @@ class TestMatmul:
             np.testing.assert_allclose(out, ref3, rtol=0,
                                        atol=2e-2 * np.abs(ref3).max())
 
-    @pytest.mark.parametrize("variant", ["classic", "folded", "exact"])
+    @pytest.mark.parametrize("variant", ["classic", "fma", "folded", "exact"])
     def test_kernel_multirow_prefill_chunk(self, variant):
         """Prefill-sized inputs (t=8 rows, under PALLAS_MAX_ROWS) through
         every dequant variant — the multi-row path the auto dispatch uses
@@ -358,3 +358,32 @@ def test_f16_bits_to_f32_exhaustive():
     got = np.asarray(q40._f16_bits_to_f32(jnp.asarray(bits[finite])))
     exp = bits[finite].view(np.float16).astype(np.float32)
     np.testing.assert_array_equal(got, exp)
+
+
+def test_extreme_scales_roundtrip_through_kernel():
+    """Scales at the f16 extremes — subnormal deltas (tiny weights) and
+    near-max deltas (|w| up to ~524k pre-clamp) — must dequantize exactly
+    through the uint16 bit path in both the XLA and interpret-kernel
+    implementations."""
+    rng = np.random.RandomState(0)
+    w = rng.randn(64, 128).astype(np.float32)
+    w[:32] *= 1e-7          # subnormal f16 deltas (amax/8 < 6.1e-5)
+    w[32:] *= 5e4           # deltas near the f16 normal range top
+    qt = q40.quantize(w)
+    assert qt.scales.dtype == jnp.uint16
+    dq = np.asarray(q40.dequantize(qt))
+    # independent reconstruction from the stored f16 bits
+    sc = np.asarray(qt.scales).view(np.float16).astype(np.float32)
+    v = np.asarray(qt.qpacked).astype(np.int32)
+    lo = (v & 0xF) - 8
+    hi = (v >> 4) - 8
+    dense = np.concatenate(
+        [lo.reshape(2, 16, 128), hi.reshape(2, 16, 128)], axis=1
+    ).reshape(64, 128) * np.repeat(sc, 32, axis=0)
+    np.testing.assert_array_equal(dq, dense.astype(np.float32))
+
+    x = _rand((1, 64), seed=1, scale=1.0)
+    ref = x @ dq
+    out = np.asarray(q40.matmul(jnp.asarray(x), qt, impl="pallas_interpret"))
+    np.testing.assert_allclose(out, ref, rtol=0,
+                               atol=2e-2 * np.abs(ref).max() + 1e-12)

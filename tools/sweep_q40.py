@@ -47,7 +47,7 @@ def shapes():
 # 1 KB bursts on a 22 KB stride).  tile_n below 256 is illegal (the
 # scales block spec needs tn/32 ≥ 8 sublanes).
 CONFIGS = [
-    ("classic", 1024, 1024), ("folded", 1024, 1024),
+    ("classic", 1024, 1024), ("fma", 1024, 1024), ("folded", 1024, 1024),
     ("classic", 512, 2048), ("folded", 512, 2048),
     ("classic", 256, 4096), ("folded", 256, 4096),
     ("classic", 512, 4096),
