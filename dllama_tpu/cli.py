@@ -188,20 +188,24 @@ def cmd_inference(args) -> None:
           f"{stats.avg_recv_bytes / 1024:.1f} kB")
 
     if args.profile_split:
-        from .runtime.profiling import profiled_split
+        from .runtime.profiling import summarize_split, traced_op_times
         if engine.pos + 4 > engine.seq_len:
             engine.reset()
             engine.prefill(ids)
         last = ids[-1]
-        split = profiled_split(lambda: engine.decode_one(last), steps=3)
-        if split is None:
+        n_steps = 3
+        times = traced_op_times(lambda: engine.decode_one(last), steps=n_steps)
+        if times is None:
             print("Profiled split:      unavailable (xplane tooling missing)")
         else:
+            sp = summarize_split(times, n_steps)
             n_dev = engine.mesh.size
             print(f"Profiled decode step (mesh sum / {n_dev} devices): "
-                  f"compute {split['compute_ms']:.2f} ms, "
-                  f"collectives {split['collective_ms']:.2f} ms "
-                  f"({split['collective_pct']:.1f}%)")
+                  f"compute {sp['compute_ms']:.2f} ms, "
+                  f"collectives {sp['collective_ms']:.2f} ms "
+                  f"({sp['collective_pct']:.1f}%)")
+            for op, ms in sorted(times.items(), key=lambda kv: -kv[1])[:5]:
+                print(f"  top op {ms / n_steps:8.2f} ms  {op}")
 
 
 def cmd_generate(args) -> None:

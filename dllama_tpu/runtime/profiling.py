@@ -56,6 +56,15 @@ def _iter_op_events(path: str):
         op_lines = [ln for ln in lines if ln.name == "XLA Ops"]
         if op_lines:
             lines = op_lines
+        elif plane.name == "/host:CPU":
+            # the CPU backend records executed ops on the PjRt client
+            # thread line; the 'python' and codegen-pass lines carry
+            # host/compiler events whose names (simplification,
+            # backend_compile_and_load, …) would otherwise pass the HLO
+            # name filter and book compile time as op time
+            # match any client-thread naming generation (TfrtCpuClient,
+            # XLAPjRtCpuClient, ...)
+            lines = [ln for ln in lines if "CpuClient" in ln.name]
         for line in lines:
             for ev in line.events:
                 name = md.get(ev.metadata_id, "")
@@ -114,7 +123,9 @@ def traced_op_times(step: Callable[[], None], steps: int = 1) -> dict[str, float
         files = glob.glob(d + "/**/*.xplane.pb", recursive=True)
         if not files:
             return None
-        return op_times(d)
+        # an empty dict means the plane/line naming assumptions missed —
+        # report unavailable rather than a plausible-looking zero split
+        return op_times(d) or None
 
 
 def split_op_times(times: dict[str, float]) -> tuple[float, float]:
@@ -124,6 +135,21 @@ def split_op_times(times: dict[str, float]) -> tuple[float, float]:
     compute = sum(ms for op, ms in times.items() if not _COLLECTIVE.search(op))
     collective = sum(ms for op, ms in times.items() if _COLLECTIVE.search(op))
     return compute, collective
+
+
+def summarize_split(times: dict[str, float], steps: int = 1) -> dict:
+    """Per-step compute/collective summary of a per-op times dict — the
+    single home of the averaging and percentage math (used by
+    :func:`profiled_split`, the CLI's --profile-split, and the bench)."""
+    compute_ms, collective_ms = split_op_times(times)
+    compute_ms /= steps
+    collective_ms /= steps
+    total = compute_ms + collective_ms
+    return {
+        "compute_ms": compute_ms,
+        "collective_ms": collective_ms,
+        "collective_pct": 100.0 * collective_ms / total if total > 0 else 0.0,
+    }
 
 
 def profiled_split(step: Callable[[], None], steps: int = 3) -> dict | None:
@@ -137,12 +163,4 @@ def profiled_split(step: Callable[[], None], steps: int = 3) -> dict | None:
     times = traced_op_times(step, steps)
     if times is None:
         return None
-    compute_ms, collective_ms = split_op_times(times)
-    compute_ms /= steps
-    collective_ms /= steps
-    total = compute_ms + collective_ms
-    return {
-        "compute_ms": compute_ms,
-        "collective_ms": collective_ms,
-        "collective_pct": 100.0 * collective_ms / total if total > 0 else 0.0,
-    }
+    return summarize_split(times, steps)

@@ -226,6 +226,26 @@ class TestModel:
         assert ref == got
 
     @needs_8
+    def test_engine_sp_multi_turn_continuation(self):
+        """Chat-style incremental prefill on an sp mesh: the second turn's
+        continuation prefill (pos > 0, T > 1 — the non-ring sp prefill
+        path) must match a single full prefill, like the single-device
+        engine guarantees."""
+        cfg = tiny_config(dim=64, hidden_dim=96, n_layers=2, n_heads=4,
+                          n_kv_heads=2, vocab_size=128, seq_len=64)
+        params = init_params(cfg, seed=4)
+        mesh = make_mesh(tp=1, sp=4, dp=1, devices=jax.devices()[:4])
+        e = Engine(cfg, params, mesh=mesh)
+        e.prefill([4, 7, 1])
+        l_cont, _ = e.prefill([9, 3])
+        e2 = Engine(cfg, params, mesh=mesh)
+        l_full, _ = e2.prefill([4, 7, 1, 9, 3])
+        np.testing.assert_allclose(l_cont, l_full, atol=1e-4, rtol=1e-3)
+        # and both match the single-device engine
+        l_ref, _ = Engine(cfg, params).prefill([4, 7, 1, 9, 3])
+        np.testing.assert_allclose(l_full, l_ref, atol=1e-4, rtol=1e-3)
+
+    @needs_8
     def test_engine_ring_prefill_equivalence(self):
         """A long from-scratch prompt on an sp mesh takes the ring-prefill
         path (sequence-sharded tokens, blockwise attention) and still
