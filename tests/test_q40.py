@@ -288,6 +288,31 @@ class TestModel:
         np.testing.assert_allclose(np.asarray(lq), np.asarray(ld),
                                    rtol=0, atol=5e-2 + 2e-2 * np.abs(np.asarray(ld)).max())
 
+    def test_quantized_forward_padded_hidden(self):
+        """Hidden dim ≥ TILE_N but not a multiple (TinyLlama's 5632 shape
+        class): the w2 input axis gets pack-time padding rows whose zero
+        scales must contribute nothing — checked through a full forward,
+        both matmul implementations."""
+        from dllama_tpu.models.config import tiny_config
+        from dllama_tpu.models.params import init_params, quantize_matmuls
+        from dllama_tpu.models.transformer import forward, init_kv_cache
+
+        cfg = tiny_config(dim=64, hidden_dim=q40.TILE_N + 384, n_layers=2,
+                          n_heads=4, n_kv_heads=2, vocab_size=128, seq_len=32)
+        assert q40.padded_n(cfg.hidden_dim) != cfg.hidden_dim  # padding active
+        params = init_params(cfg, seed=2)
+        qparams = quantize_matmuls(params, cfg)
+        dparams = {k: (q40.dequantize(v, jnp.float32) if isinstance(v, q40.QTensor) else v)
+                   for k, v in qparams.items()}
+        tokens = jnp.asarray([[1, 5, 9, 2]], jnp.int32)
+        ld, _ = forward(dparams, cfg, tokens, init_kv_cache(cfg, 1), jnp.int32(0))
+        tol = 5e-2 + 2e-2 * np.abs(np.asarray(ld)).max()
+        for impl in ("xla", "pallas_interpret"):
+            lq, _ = forward(qparams, cfg.with_(quant_impl=impl), tokens,
+                            init_kv_cache(cfg, 1), jnp.int32(0))
+            np.testing.assert_allclose(np.asarray(lq), np.asarray(ld),
+                                       rtol=0, atol=tol)
+
     def test_tp_sharded_quantized_equivalence(self):
         """N-shard ≡ 1-shard (commands-test.cpp pattern) with packed Q40
         weights: the sharded run uses the partitionable XLA impl."""
