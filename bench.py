@@ -11,18 +11,32 @@ Q40 number — 101.81 ms/token = 9.82 tok/s on a c3d-highcpu-30 VM
 from this harness (one v5e chip via the axon tunnel).
 
 Architecture (hardened after r01, where a hanging backend init burned the
-whole window and produced no JSON at all): a parent orchestrator spawns
-each stage as a subprocess with a hard timeout under a global wall-clock
-budget (env BENCH_BUDGET_S, default 1500 s) —
+whole window and produced no JSON at all; re-hardened after r03, where the
+axon relay was dead at round end, the single 420 s probe burned its whole
+timeout, and the round recorded only a degraded CPU number): a parent
+orchestrator spawns each stage as a subprocess with a hard timeout under a
+global wall-clock budget (env BENCH_BUDGET_S, default 1500 s) —
 
-  1. backend probe: `jax.devices()` only; bounded, so a wedged TPU tunnel
-     costs minutes, not the session;
+  0. relay watch: a dead relay makes `jax.devices()` block forever inside
+     the PJRT claim, so a full JAX probe is only paid for when a 2 s TCP
+     connect to the relay port (127.0.0.1:8093) succeeds.  The orchestrator
+     polls the port across the run window and probes at the FIRST sign of
+     life — a flaky tunnel that comes up mid-window still gets benched;
+  1. backend probe: `jax.devices()` only; bounded and repeatable (short
+     timeouts, multiple attempts), so a wedged TPU tunnel costs minutes,
+     not the session;
   2. llama2-7b Q40 greedy decode on the TPU (the config with a published
      reference number), preceded by an in-process pallas-vs-XLA hardware
      equality check on the fused kernel;
-  3. tinyllama-1.1b fallback if the 7B working set fails;
-  4. degraded CPU fallback (tiny shapes, vs_baseline null) so the driver
+  3. llama3-8b immediately after — the BASELINE.json north-star metric
+     gets an early slot so late-window tunnel loss cannot starve it;
+  4. tinyllama-1.1b fallback if the 7B working set fails;
+  5. degraded CPU fallback (tiny shapes, vs_baseline null) so the driver
      always records a parsed line even with the TPU unreachable.
+
+Secondary hardware numbers (llama3-8b, 16k long-context) are logged to
+stderr AND embedded in the final JSON line under "extras" so they survive
+into BENCH_r{N}.json either way.
 
 The timing loop is greedy (temperature 0 → on-device argmax): sampling
 cost is not the metric the baseline measures (the reference samples on
@@ -40,8 +54,25 @@ import sys
 import time
 
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1500"))
-PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "420"))
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
 BASELINE_7B_TOKS = 9.82  # README.md:126 — 101.81 ms/token, 1× c3d-highcpu-30
+# the axon relay's remote-compile HTTP endpoint; when this port is not even
+# listening, the PJRT claim inside jax.devices() blocks forever (observed
+# r03) — so the TCP check below is the cheap gate in front of every probe
+RELAY_PORT = int(os.environ.get("BENCH_RELAY_PORT", "8093"))
+RELAY_HOST = (os.environ.get("PALLAS_AXON_POOL_IPS", "").split(",")[0].strip()
+              or "127.0.0.1")
+
+
+def _relay_listening(timeout_s: float = 2.0) -> bool:
+    """True when the axon relay port accepts a TCP connect — a cheap
+    (≤2 s) necessary condition for the TPU tunnel being alive."""
+    import socket
+    try:
+        with socket.create_connection((RELAY_HOST, RELAY_PORT), timeout=timeout_s):
+            return True
+    except OSError:
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -397,13 +428,37 @@ def _spawn(name, timeout_s, env_extra=None):
         return None
 
 
-def _emit(result):
+_EMITTED = False
+
+
+def _emit(result, extras=None):
+    global _EMITTED
     result.pop("backend", None)
+    if extras:
+        result["extras"] = extras
     print(json.dumps(result))
+    _EMITTED = True
+
+
+def _install_term_handler():
+    """If the driver tears the bench down (SIGTERM) before a number was
+    emitted, still print a parseable last-resort line — a killed bench must
+    never leave BENCH_r{N}.json without JSON (r03 lesson, generalized)."""
+    import signal
+
+    def _on_term(signum, frame):
+        if not _EMITTED:
+            _emit({"metric": "bench interrupted before a number was produced",
+                   "value": 0.0, "unit": "tok/s", "vs_baseline": None})
+            sys.stdout.flush()
+        os._exit(1)
+
+    signal.signal(signal.SIGTERM, _on_term)
 
 
 def main():
     t_start = time.time()
+    _install_term_handler()
 
     def remaining():
         return BUDGET_S - (time.time() - t_start)
@@ -412,32 +467,91 @@ def main():
     from dllama_tpu.hostenv import forced_cpu_env
     cpu_env = forced_cpu_env(1)
 
-    probe = _spawn("probe", min(PROBE_TIMEOUT_S, max(remaining() - 420, 60)))
-    on_hw = probe is not None and probe.get("platform") != "cpu"
+    # --- probe phase (r03 postmortem): poll the relay port across the
+    # window and pay for a JAX probe only at the first sign of life, so a
+    # tunnel that is down now but comes back mid-window still gets benched.
+    # RESERVE keeps enough tail for the degraded CPU fallback either way.
+    RESERVE = 180.0
 
+    def _hw(p):
+        return p is not None and p.get("platform") != "cpu"
+
+    probe = None
+    probes_attempted = 0
+    blind_probe_done = False
+    waiting_logged = False
+    while remaining() > RESERVE + 240:
+        if _relay_listening():
+            probe = _spawn("probe",
+                           min(PROBE_TIMEOUT_S, remaining() - RESERVE - 60))
+            probes_attempted += 1
+            if _hw(probe):
+                break
+            # port open but the claim failed, hung, or fell to CPU — a
+            # half-up relay; back off briefly and re-try while the window
+            # allows (each probe subprocess re-registers the backend, so a
+            # later attempt can still find the TPU)
+            print("bench: relay port open but no TPU probe yet; retrying",
+                  file=sys.stderr)
+            time.sleep(20)
+        else:
+            if not waiting_logged:
+                print(f"bench: relay {RELAY_HOST}:{RELAY_PORT} not listening; "
+                      "polling for tunnel across the run window", file=sys.stderr)
+                waiting_logged = True
+            # one blind probe mid-window guards against the port heuristic
+            # itself being wrong (e.g. relay moved ports but tunnel alive)
+            if not blind_probe_done and remaining() < BUDGET_S * 0.55:
+                blind_probe_done = True
+                probe = _spawn("probe", min(90, remaining() - RESERVE - 60))
+                probes_attempted += 1
+                if _hw(probe):
+                    break
+            time.sleep(15)
+    if not _hw(probe) and probes_attempted == 0:
+        # small budgets skip the poll loop entirely — still probe once so a
+        # healthy TPU is never bypassed (pre-r04 behavior, ≥45 s timeout)
+        probe = _spawn("probe", min(PROBE_TIMEOUT_S,
+                                    max(remaining() - 120, 45)))
+    on_hw = _hw(probe)
+
+    extras = {}
     if on_hw:
         # kernel variant/tile choice is settled offline (tools/sweep_q40.py
-        # + the xplane profile, docs/PERF.md): classic @ (1024, 1024) — an
-        # in-bench sweep at jit-scan fidelity would cost several minutes of
-        # compile per config, which this budget spends on the headline
-        # stages instead
+        # + the xplane profile, docs/PERF.md) — an in-bench sweep at
+        # jit-scan fidelity would cost several minutes of compile per
+        # config, which this budget spends on the headline stages instead
         chunk_out = None
         for name in ("llama2-7b", "tinyllama-1.1b"):
-            budget = remaining() - 360  # keep room for the CPU fallback
+            budget = remaining() - RESERVE  # keep room for the CPU fallback
             if budget < 180:
                 print("bench: budget exhausted, skipping to fallback", file=sys.stderr)
+                break
+            if not _relay_listening(5.0):
+                print("bench: relay died before headline stage", file=sys.stderr)
                 break
             chunk_out = _spawn(name, min(budget, 900))
             if chunk_out:
                 break
+        got_7b = bool(chunk_out) and "llama2-7b" in chunk_out.get("metric", "")
+        # BASELINE.json north-star (Llama-3-8B, target ≥80 tok/s/chip) gets
+        # the EARLY slot right after the headline (VERDICT r03 Next #3): a
+        # tunnel that dies late in the window must not starve the one metric
+        # BASELINE actually names.  Recorded in the final JSON's "extras".
+        if got_7b and remaining() > RESERVE + 200 and _relay_listening(5.0):
+            l3_out = _spawn("llama3-8b",
+                            min(remaining() - RESERVE - 60, 480))
+            if l3_out:
+                extras["llama3-8b_toks"] = l3_out["value"]
+                print(f"bench: north-star config: {json.dumps(l3_out)}",
+                      file=sys.stderr)
         # the operator-surface run (synth .m → loader → Engine → CLI stats)
         # is the headline number when it completes (VERDICT r02 Next #3);
         # the decode_chunk number above remains the recorded cross-check.
         # Only attempted when the 7B shape itself just worked — a tinyllama
         # fallback means 7B failed and re-running it would burn the budget.
         cli_out = None
-        if chunk_out and "llama2-7b" in chunk_out.get("metric", "") \
-                and remaining() > 480:
+        if got_7b and remaining() > RESERVE + 300 and _relay_listening(5.0):
             # the grandchild CLI process is killed at an absolute deadline
             # strictly inside the attempt timeout, so a hang can never
             # orphan it on the TPU (synthesis time is inside the deadline)
@@ -449,7 +563,7 @@ def main():
         # Runs after the headline stages (a hang here costs diagnostics, not
         # the number) but before the optional long-context stage, which must
         # not starve it of budget.
-        if chunk_out and remaining() > 300:
+        if chunk_out and remaining() > RESERVE + 120 and _relay_listening(5.0):
             here = os.path.dirname(os.path.abspath(__file__))
             try:
                 r = subprocess.run(
@@ -465,31 +579,21 @@ def main():
                 print(f"bench: moe hw check failed ({type(e).__name__})",
                       file=sys.stderr)
         # long-context decode evidence: 16k cache, decode deep in a live
-        # prefix stays usable because attention reads O(pos) — stderr-only.
-        # Same gate as the llama3 stage below: this one runs first because
-        # long context is the flagship beyond-reference capability.
-        if chunk_out and "llama2-7b" in chunk_out.get("metric", "") \
-                and remaining() > 460:
+        # prefix stays usable because attention reads O(pos) — the flagship
+        # beyond-reference capability; recorded in "extras".
+        if got_7b and remaining() > RESERVE + 280 and _relay_listening(5.0):
             long_out = _spawn("llama2-7b-long", 300)
             if long_out:
+                extras["llama2-7b_16k_toks"] = long_out["value"]
                 print(f"bench: long-context: {json.dumps(long_out)}",
                       file=sys.stderr)
-        # north-star config evidence (BASELINE.json: Llama-3-8B): GQA +
-        # 128k vocab decode on one chip — stderr-only
-        if chunk_out and "llama2-7b" in chunk_out.get("metric", "") \
-                and remaining() > 460:
-            l3_out = _spawn("llama3-8b", 300)
-            if l3_out:
-                print(f"bench: north-star config: {json.dumps(l3_out)}",
-                      file=sys.stderr)
-        # tile probe: the #1 open perf question (docs/PERF.md) is whether a
-        # wider tile_d lifts the wide-output shapes' DMA rate; time just the
-        # w13 shape at the default and the hypothesis config so the answer
-        # lands in every driver log — one remote compile per config
-        if chunk_out and remaining() > 500:
+        # tile probe: measure the tile_d/DMA-stride lever (docs/PERF.md #1)
+        # on the wide-output w13 shape so the answer lands in every driver
+        # log — one remote compile per config
+        if chunk_out and remaining() > RESERVE + 320 and _relay_listening(5.0):
             here = os.path.dirname(os.path.abspath(__file__))
             for tn, td in ((1024, 1024), (512, 2048)):
-                if remaining() < 150:
+                if remaining() < RESERVE + 60:
                     break
                 try:
                     r = subprocess.run(
@@ -505,10 +609,10 @@ def main():
         if cli_out:
             print(f"bench: decode_chunk cross-check: {json.dumps(chunk_out)}",
                   file=sys.stderr)
-            _emit(cli_out)
+            _emit(cli_out, extras)
             return
         if chunk_out:
-            _emit(chunk_out)
+            _emit(chunk_out, extras)
             return
     else:
         print("bench: TPU backend unreachable — degraded CPU mode", file=sys.stderr)
