@@ -433,27 +433,44 @@ _EMITTED = False
 
 def _emit(result, extras=None):
     global _EMITTED
+    _EMITTED = True  # set BEFORE print: a SIGTERM landing mid-print must
+    # not add a second JSON line after the real one (driver parses the last)
     result.pop("backend", None)
     if extras:
         result["extras"] = extras
-    print(json.dumps(result))
-    _EMITTED = True
+    print(json.dumps(result), flush=True)
 
 
 def _install_term_handler():
     """If the driver tears the bench down (SIGTERM) before a number was
     emitted, still print a parseable last-resort line — a killed bench must
-    never leave BENCH_r{N}.json without JSON (r03 lesson, generalized)."""
+    never leave BENCH_r{N}.json without JSON (r03 lesson, generalized).
+    The handler uses os.write, not print(): stdout's BufferedWriter is not
+    reentrant, and the signal can land inside _emit's own print."""
     import signal
+
+    _PAYLOAD = (json.dumps(
+        {"metric": "bench interrupted before a number was produced",
+         "value": 0.0, "unit": "tok/s", "vs_baseline": None}) + "\n").encode()
 
     def _on_term(signum, frame):
         if not _EMITTED:
-            _emit({"metric": "bench interrupted before a number was produced",
-                   "value": 0.0, "unit": "tok/s", "vs_baseline": None})
-            sys.stdout.flush()
+            os.write(1, _PAYLOAD)
         os._exit(1)
 
     signal.signal(signal.SIGTERM, _on_term)
+
+
+def _relay_up(attempts: int = 3, delay_s: float = 5.0) -> bool:
+    """Relay liveness with retries, for mid-run stage gates: one dropped SYN
+    right after a successful probe must not abort the whole hardware run
+    (the probe phase retries for minutes; stages deserve more than one shot)."""
+    for i in range(attempts):
+        if _relay_listening(5.0):
+            return True
+        if i < attempts - 1:
+            time.sleep(delay_s)
+    return False
 
 
 def main():
@@ -527,7 +544,7 @@ def main():
             if budget < 180:
                 print("bench: budget exhausted, skipping to fallback", file=sys.stderr)
                 break
-            if not _relay_listening(5.0):
+            if not _relay_up():
                 print("bench: relay died before headline stage", file=sys.stderr)
                 break
             chunk_out = _spawn(name, min(budget, 900))
@@ -538,7 +555,7 @@ def main():
         # the EARLY slot right after the headline (VERDICT r03 Next #3): a
         # tunnel that dies late in the window must not starve the one metric
         # BASELINE actually names.  Recorded in the final JSON's "extras".
-        if got_7b and remaining() > RESERVE + 200 and _relay_listening(5.0):
+        if got_7b and remaining() > RESERVE + 200 and _relay_up():
             l3_out = _spawn("llama3-8b",
                             min(remaining() - RESERVE - 60, 480))
             if l3_out:
@@ -551,7 +568,7 @@ def main():
         # Only attempted when the 7B shape itself just worked — a tinyllama
         # fallback means 7B failed and re-running it would burn the budget.
         cli_out = None
-        if got_7b and remaining() > RESERVE + 300 and _relay_listening(5.0):
+        if got_7b and remaining() > RESERVE + 300 and _relay_up():
             # the grandchild CLI process is killed at an absolute deadline
             # strictly inside the attempt timeout, so a hang can never
             # orphan it on the TPU (synthesis time is inside the deadline)
@@ -563,7 +580,7 @@ def main():
         # Runs after the headline stages (a hang here costs diagnostics, not
         # the number) but before the optional long-context stage, which must
         # not starve it of budget.
-        if chunk_out and remaining() > RESERVE + 120 and _relay_listening(5.0):
+        if chunk_out and remaining() > RESERVE + 120 and _relay_up():
             here = os.path.dirname(os.path.abspath(__file__))
             try:
                 r = subprocess.run(
@@ -581,7 +598,7 @@ def main():
         # long-context decode evidence: 16k cache, decode deep in a live
         # prefix stays usable because attention reads O(pos) — the flagship
         # beyond-reference capability; recorded in "extras".
-        if got_7b and remaining() > RESERVE + 280 and _relay_listening(5.0):
+        if got_7b and remaining() > RESERVE + 280 and _relay_up():
             long_out = _spawn("llama2-7b-long", 300)
             if long_out:
                 extras["llama2-7b_16k_toks"] = long_out["value"]
@@ -590,7 +607,7 @@ def main():
         # tile probe: measure the tile_d/DMA-stride lever (docs/PERF.md #1)
         # on the wide-output w13 shape so the answer lands in every driver
         # log — one remote compile per config
-        if chunk_out and remaining() > RESERVE + 320 and _relay_listening(5.0):
+        if chunk_out and remaining() > RESERVE + 320 and _relay_up():
             here = os.path.dirname(os.path.abspath(__file__))
             for tn, td in ((1024, 1024), (512, 2048)):
                 if remaining() < RESERVE + 60:

@@ -1,0 +1,129 @@
+"""Cross-parity against the ACTUAL reference binary (VERDICT r03 Next #4).
+
+Every other numerics test compares the runtime to `tests/reference_impl.py`,
+an independent numpy rewrite — but both were written by the same author.
+This suite removes that blind spot: it builds the reference's C++ `dllama`
+from `/root/reference` (Makefile:11-41 recipe, compiled out-of-tree because
+the reference checkout is read-only), synthesizes a tiny model + tokenizer
+through OUR writers (`io/mfile.MFileWriter`, `io/tfile.write_tfile`), runs
+BOTH engines' `generate` mode at temperature 0, and asserts the token
+streams are identical — the spirit of the reference's own golden-output
+test (llama2-tasks-test.cpp:556-605), but with the real binary as oracle.
+
+What identical streams certify end-to-end:
+  * `.m`/`.t` byte compatibility (the reference binary parses our files);
+  * tokenizer encode parity (the forced prompt pieces match);
+  * forward-pass numerics parity (24 greedy argmax steps agree — through
+    rmsnorm, RoPE, GQA attention, SiLU FFN, and the Q40 codec for the
+    quantized case);
+  * sampler greedy semantics (tokenizer.cpp:387-389).
+
+Print-alignment note: the reference prints transition pieces t0→t1 …
+t_{S-1}→t_S (dllama.cpp:45-93), ours prints bos→t0 … t_{S-2}→t_{S-1}
+(cli.py cmd_generate) — so ours equals "<s>" + (reference text minus its
+final piece).  The assertions below encode exactly that relation.
+
+Skipped when g++ or the reference checkout is unavailable.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dllama_tpu import quants
+from dllama_tpu.io import mfile
+
+from fixtures import REPO, run_cli, write_tiny_tokenizer
+
+REF = os.environ.get("DLLAMA_REF", "/root/reference")
+BUILD = os.path.join(REPO, "build", "ref")
+# translation units from the reference Makefile's `dllama` rule
+_TUS = ["utils", "quants", "funcs", "commands", "socket", "transformer",
+        "tasks", "llama2-tasks", "grok1-tasks", "mixtral-tasks", "tokenizer",
+        "app"]
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None or not os.path.isfile(
+        os.path.join(REF, "src", "apps", "dllama", "dllama.cpp")),
+    reason="needs g++ and the reference checkout")
+
+
+def _ref_binary() -> str:
+    """Build (once, cached in build/ref) and return the reference dllama."""
+    exe = os.path.join(BUILD, "dllama")
+    if os.path.isfile(exe):
+        return exe
+    os.makedirs(BUILD, exist_ok=True)
+    cc = ["g++", "-std=c++11", "-O2", "-march=native"]
+    objs = []
+    for tu in _TUS:
+        obj = os.path.join(BUILD, tu + ".o")
+        subprocess.run(cc + ["-c", os.path.join(REF, "src", tu + ".cpp"),
+                             "-o", obj], check=True, timeout=180)
+        objs.append(obj)
+    subprocess.run(cc + [os.path.join(REF, "src", "apps", "dllama", "dllama.cpp"),
+                         "-o", exe] + objs + ["-lpthread"], check=True, timeout=180)
+    return exe
+
+
+def _write_model(path: str, ftype: int) -> None:
+    # dims are reference-legal for every weights ftype: its Q40 microkernel
+    # asserts n % 256 == 0 on each matmul's input dim (funcs.cpp:213-217)
+    spec = mfile.ModelSpec(
+        arch=mfile.ARCH_LLAMA, dim=256, hidden_dim=512, n_layers=2, n_heads=4,
+        n_kv_heads=2, n_experts=0, n_active_experts=0, vocab_size=128,
+        seq_len=64, hidden_act=mfile.ACT_SILU, rope_theta=10000.0,
+        weights_ftype=ftype)
+    rng = np.random.RandomState(3)
+    with mfile.MFileWriter(path, spec) as w:
+        for t in w.plan:
+            w.write_tensor(t.name, (rng.randn(*t.shape) * 0.05).astype(np.float32))
+
+
+def _ref_generate(exe: str, mpath: str, tpath: str, prompt: str, steps: int) -> str:
+    r = subprocess.run(
+        [exe, "generate", "--model", mpath, "--tokenizer", tpath,
+         "--prompt", prompt, "--steps", str(steps), "--temperature", "0",
+         "--seed", "1", "--nthreads", "2", "--buffer-float-type", "f32"],
+        capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    lines = r.stdout.splitlines()
+    # the stream is the single line between the loader's "Loaded" line and
+    # the "Generated tokens:" stats block
+    idx = next(i for i, l in enumerate(lines) if l.startswith("Generated tokens:"))
+    return lines[idx - 1]
+
+
+def _our_generate(mpath: str, tpath: str, prompt: str, steps: int) -> str:
+    r = run_cli(["generate", "--model", mpath, "--tokenizer", tpath,
+                 "--prompt", prompt, "--steps", str(steps), "--temperature", "0",
+                 "--seed", "1", "--buffer-float-type", "f32", "--chunk", "8"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    return r.stdout.splitlines()[-1]
+
+
+@pytest.mark.parametrize("ftype", [quants.F32, quants.Q40],
+                         ids=["f32-weights", "q40-weights"])
+def test_generate_stream_matches_reference_binary(tmp_path, ftype):
+    exe = _ref_binary()
+    mpath, tpath = str(tmp_path / "toy.m"), str(tmp_path / "toy.t")
+    _write_model(mpath, ftype)
+    write_tiny_tokenizer(tpath, vocab_size=128)
+    steps = 24
+
+    ref_text = _ref_generate(exe, mpath, tpath, "hello hi", steps)
+    our_text = _our_generate(mpath, tpath, "hello hi", steps)
+
+    assert our_text.startswith("<s>hello hi"), our_text  # prompt echo + encode parity
+    gen = our_text[len("<s>"):]
+    # ours == reference minus its final transition piece (see module docstring);
+    # require the full 23 shared transitions to match exactly
+    assert ref_text.startswith(gen), f"ref={ref_text!r}\nours={gen!r}"
+    # and the match must extend well past the prompt into sampled territory
+    assert len(gen) > len("hello hi") + 20, gen
