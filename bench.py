@@ -432,13 +432,22 @@ _EMITTED = False
 
 
 def _emit(result, extras=None):
+    """Write the result line with SIGTERM blocked: one atomic os.write of
+    the full payload, flag set under the mask — no window in which a kill
+    can truncate the line, suppress the fallback, or append a second line."""
     global _EMITTED
-    _EMITTED = True  # set BEFORE print: a SIGTERM landing mid-print must
-    # not add a second JSON line after the real one (driver parses the last)
+    import signal
     result.pop("backend", None)
     if extras:
         result["extras"] = extras
-    print(json.dumps(result), flush=True)
+    payload = (json.dumps(result) + "\n").encode()
+    signal.pthread_sigmask(signal.SIG_BLOCK, {signal.SIGTERM})
+    try:
+        sys.stdout.flush()
+        os.write(1, payload)
+        _EMITTED = True
+    finally:
+        signal.pthread_sigmask(signal.SIG_UNBLOCK, {signal.SIGTERM})
 
 
 def _install_term_handler():

@@ -155,24 +155,37 @@ class Engine:
         self.cfg = cfg
         self.params = sharding.place_params(params, cfg, self.mesh)
         # sp>1 shards the cache's sequence axis: max context scales with
-        # sp × per-chip HBM (capability the reference lacks, SURVEY §5)
+        # sp × per-chip HBM (capability the reference lacks, SURVEY §5);
+        # the same sharding is pinned as jit out_shardings below so cache
+        # placement and step outputs can never silently diverge
+        self._cache_sh = sharding.kv_cache_sharding(
+            self.mesh, "sp" if self.sp > 1 else None)
         self.cache = jax.device_put(
             init_kv_cache(cfg, batch, self.seq_len, dtype=kv_dtype),
-            sharding.kv_cache_sharding(self.mesh, "sp" if self.sp > 1 else None))
+            self._cache_sh)
         self.pos = 0
 
         def step(params, cache, tokens, pos, last_index):
             return forward_last(params, cfg, tokens, cache, pos, last_index)
 
+        # Outputs that the host reads (logits, sampled tokens) are pinned
+        # replicated while the cache keeps its mesh sharding: on a
+        # multi-process mesh (parallel/distributed.py) a sharded output
+        # spans non-addressable devices and cannot be fetched — replication
+        # makes every fetch process-local (the gather rides ICI inside the
+        # program, which is where inter-chip traffic belongs; T≈0 contract).
+        self._rep = NamedSharding(self.mesh, P())
         # one compiled program per (batch, T-bucket); decode is bucket T=1
-        self._step = jax.jit(step, donate_argnums=(1,), static_argnames=())
+        self._step = jax.jit(step, donate_argnums=(1,),
+                             out_shardings=(self._rep, self._cache_sh))
         if self.sp > 1:
             cfg_ring = cfg.with_(ring_prefill=True)
 
             def ring_step(params, cache, tokens, pos, last_index):
                 return forward_last(params, cfg_ring, tokens, cache, pos, last_index)
 
-            self._step_ring = jax.jit(ring_step, donate_argnums=(1,))
+            self._step_ring = jax.jit(ring_step, donate_argnums=(1,),
+                                      out_shardings=(self._rep, self._cache_sh))
         self._chunk_fns: dict = {}
         self._key = jax.random.PRNGKey(0)
         self._chunk_counter = 0
@@ -251,7 +264,11 @@ class Engine:
                 lambda p, c, tok, pos, k: decode_chunk(
                     p, cfg, c, tok, pos, k,
                     steps=steps, temperature=key[1], topp=key[2]),
-                donate_argnums=(1,))
+                donate_argnums=(1,),
+                # tokens/scalars replicated for process-local fetch; cache
+                # keeps its sharding (see __init__)
+                out_shardings=(self._rep, self._cache_sh,
+                               self._rep, self._rep, self._rep))
         return self._chunk_fns[key]
 
     def generate_stream(self, prompt_tokens: list[int], steps: int, *,

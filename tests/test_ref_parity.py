@@ -48,10 +48,13 @@ _TUS = ["utils", "quants", "funcs", "commands", "socket", "transformer",
         "tasks", "llama2-tasks", "grok1-tasks", "mixtral-tasks", "tokenizer",
         "app"]
 
-pytestmark = pytest.mark.skipif(
-    shutil.which("g++") is None or not os.path.isfile(
-        os.path.join(REF, "src", "apps", "dllama", "dllama.cpp")),
-    reason="needs g++ and the reference checkout")
+pytestmark = [
+    pytest.mark.slow,  # first run compiles 13 C++ TUs
+    pytest.mark.skipif(
+        shutil.which("g++") is None or not os.path.isfile(
+            os.path.join(REF, "src", "apps", "dllama", "dllama.cpp")),
+        reason="needs g++ and the reference checkout"),
+]
 
 
 def _ref_binary() -> str:
@@ -67,8 +70,12 @@ def _ref_binary() -> str:
         subprocess.run(cc + ["-c", os.path.join(REF, "src", tu + ".cpp"),
                              "-o", obj], check=True, timeout=180)
         objs.append(obj)
+    # link to a temp name then rename: an interrupted link must not leave a
+    # truncated binary that the isfile() cache check would trust forever
     subprocess.run(cc + [os.path.join(REF, "src", "apps", "dllama", "dllama.cpp"),
-                         "-o", exe] + objs + ["-lpthread"], check=True, timeout=180)
+                         "-o", exe + ".part"] + objs + ["-lpthread"],
+                   check=True, timeout=180)
+    os.replace(exe + ".part", exe)
     return exe
 
 
