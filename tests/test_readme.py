@@ -1,0 +1,41 @@
+"""Keep README claims from rotting (VERDICT r03 Weak #6 / Next #10).
+
+The README's test count is asserted against the ACTUAL collected session,
+so it can never silently drift again: when the suite grows, this test
+fails with the exact number to paste.  It only runs when the whole suite
+was collected (a -k / single-file run would see a partial count).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import pytest
+
+from fixtures import REPO
+
+
+def _full_suite_run(request) -> bool:
+    """True when the whole tests/ tree was collected with no selection —
+    the only situation where len(session.items) is the real suite size."""
+    opt = request.config.option
+    if getattr(opt, "keyword", "") or getattr(opt, "markexpr", ""):
+        return False
+    targets = [a for a in request.config.invocation_params.args
+               if not a.startswith("-")]
+    return all(os.path.abspath(t).rstrip("/") in (REPO, os.path.join(REPO, "tests"))
+               for t in targets)
+
+
+def test_readme_test_count_matches_suite(request):
+    if not _full_suite_run(request):
+        pytest.skip("partial run (-k/-m or a subset path): count not judgeable")
+    readme = open(os.path.join(REPO, "README.md")).read()
+    m = re.search(r"`tests/` \| (\d+) tests", readme)
+    assert m, "README no longer states the test count in the layout table"
+    stated = int(m.group(1))
+    actual = len(request.session.items)
+    assert stated == actual, (
+        f"README says {stated} tests but the suite collects {actual} — "
+        f"update README.md's layout table")

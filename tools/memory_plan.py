@@ -91,6 +91,17 @@ def plan(cfg, tp=1, sp=1, dp=1, ep=1, seq_len=None, batch=1,
         else:
             per_w = Q40_BYTES_PER_WEIGHT if quant else 2
             div = tp * (ep if k in ("up", "gate", "down") else 1)
+            if quant:
+                # packed planes pad the input axis to the kernel's block
+                # granularity (q40.padded_n; up to +9% on odd hidden dims,
+                # e.g. TinyLlama's 5632→6144) — estimate what HBM actually
+                # holds, not the logical element count (ADVICE r03)
+                from dllama_tpu.ops.q40 import padded_n
+                *lead, nin, dout = shp
+                n = 1
+                for x in lead:
+                    n *= x
+                n *= padded_n(nin) * dout
             w_sharded += n * per_w / div
     cache = 2 * cfg.n_layers * batch * cfg.n_kv_heads * s * cfg.head_size * kv_bytes
     cache /= tp * sp * max(dp, 1)  # kv heads /tp, seq /sp, batch /dp
