@@ -72,10 +72,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "over a dp mesh axis (beyond-reference capability; "
                         "only stream 0 is printed)")
     p.add_argument("--ep", type=int, default=1,
-                   help="expert-parallel degree for MoE models: dense expert "
-                        "stacks shard over experts instead of replicating "
-                        "(beyond-reference; the reference TP-slices all "
-                        "experts everywhere, transformer.cpp:299-317)")
+                   help="expert-parallel degree for MoE models: expert "
+                        "stacks — dense AND packed Q40 — shard over experts "
+                        "instead of replicating (beyond-reference; the "
+                        "reference TP-slices all experts everywhere, "
+                        "transformer.cpp:299-317; packed path: ops/q40.py "
+                        "_sharded_matmul_ep)")
     p.add_argument("--coordinator", default=None,
                    help="multi-host: process-0 host:port for "
                         "jax.distributed.initialize (parallel/distributed.py); "

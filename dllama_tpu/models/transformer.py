@@ -150,8 +150,11 @@ def moe_ffn(xb2d: jax.Array, lp, cfg: ModelConfig) -> jax.Array:
       one expert's weights are dequantized at a time.
 
     Experts are TP-sliced like the reference (all experts on all shards,
-    hidden dim sharded — transformer.cpp:299-317); expert-parallel layouts
-    are a sharding-spec change, not a code change.
+    hidden dim sharded — transformer.cpp:299-317).  Under an ``ep`` mesh
+    axis the expert stacks additionally shard over experts — dense via the
+    PartitionSpecs (GSPMD inserts the gather), packed Q40 via the fused
+    kernel's per-shard flat-index decode + psum (q40._sharded_matmul_ep) —
+    so MoE weight residency scales 1/ep in both layouts.
     """
     n, d = xb2d.shape
     e, k = cfg.n_experts, cfg.n_active_experts

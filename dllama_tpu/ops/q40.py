@@ -137,6 +137,15 @@ def pack_planes(qvals: np.ndarray, scales: np.ndarray) -> QTensor:
     """Device-array wrapper over :func:`pack_planes_np` (scales upload as
     their f16 bit pattern — see the module docstring)."""
     packed, sc, nd = pack_planes_np(qvals, scales)
+    # every QTensor producer funnels through here (quantize, pack_planes_t)
+    # except the raw-byte loader (pack_file_groups, same check there): a
+    # block whose delta overflowed f16 must fail loudly — the in-kernel
+    # bit decode has no exp==0x1F branch and would yield finite garbage
+    # (ADVICE r03)
+    if not np.isfinite(sc).all():
+        raise ValueError(
+            "Q40 scale overflowed f16 (|block amax| > 8*65504) or is NaN — "
+            "quantizing these values would corrupt the packed planes")
     return QTensor(jnp.asarray(packed), jnp.asarray(sc.view(np.uint16)), nd)
 
 
@@ -656,6 +665,63 @@ def _sharded_matmul(x2: jax.Array, qp: jax.Array, s: jax.Array,
                          out_specs=ospec, check_vma=False)(*args)
 
 
+def _sharded_matmul_ep(x2: jax.Array, qp4: jax.Array, s4: jax.Array,
+                       flat_idx: jax.Array, kind: str, mesh,
+                       interp: bool) -> jax.Array:
+    """Expert-parallel fused matmul on a ``(L, E, n/2, d)`` packed stack
+    whose expert axis is sharded over ``ep`` (hidden axis over ``tp``).
+
+    The reference TP-slices every expert onto every node (transformer.cpp:
+    299-317), which caps the model size at nSlices ≤ nKvHeads; sharding the
+    expert axis is the extra degree of freedom that lets packed Grok-1-314B
+    fit a 16-chip v5e mesh (tools/memory_plan.py).  Mechanism:
+
+    * each shard holds ``E/ep`` experts per layer; the traced flat
+      ``layer·E + expert`` index (QLayerView.select) is decoded per shard
+      into (layer, expert), and the owner runs the kernel on its local
+      sub-stack while every other shard's input is masked to zero;
+    * a psum over ``ep`` (and ``tp`` for col-sharded weights) then
+      replicates the true product everywhere, so each of up/gate/down is
+      independently correct and composable no matter which impl the other
+      matmuls of the FFN picked (no "unreduced intermediate" contract).
+
+    Per-decode-step HBM cost is unchanged (each shard still streams one
+    expert's packed tiles per (token, slot) — the non-owners stream a
+    clamped expert and discard); weight residency drops by ``ep``.  Skipping
+    the non-owner reads needs a lax.cond around the kernel and is a future
+    lever.
+    """
+    tp = mesh.shape.get("tp", 1)
+    ep = mesh.shape["ep"]
+    tp_ax = "tp" if tp > 1 else None
+    if kind == "row":
+        wspec = P(None, "ep", None, tp_ax)
+        xspec, ospec = P(None, None), P(None, tp_ax)
+        sum_axes: tuple = ("ep",)
+    else:  # col
+        wspec = P(None, "ep", tp_ax, None)
+        xspec = P(None, tp_ax)
+        ospec = P(None, None)
+        sum_axes = ("ep", "tp") if tp_ax else ("ep",)
+
+    def body(x_local, qp, s, flat):
+        e_local = qp.shape[1]
+        layer_idx = flat // (e_local * ep)
+        sel = flat % (e_local * ep)
+        local_sel = sel - jax.lax.axis_index("ep") * e_local
+        owned = (local_sel >= 0) & (local_sel < e_local)
+        lflat = layer_idx * e_local + jnp.clip(local_sel, 0, e_local - 1)
+        xm = x_local * owned.astype(x_local.dtype)
+        out = _pallas_matmul_stacked(
+            xm, qp.reshape((-1,) + qp.shape[-2:]),
+            s.reshape((-1,) + s.shape[-2:]), lflat, interpret=interp)
+        return jax.lax.psum(out, sum_axes)
+
+    return jax.shard_map(body, mesh=mesh,
+                         in_specs=(xspec, wspec, wspec, P()),
+                         out_specs=ospec, check_vma=False)(x2, qp4, s4, flat_idx)
+
+
 _FALLBACK_WARNED: set = set()
 
 
@@ -750,9 +816,20 @@ def matmul(x: jax.Array, qt: QTensor | QLayerView, impl: str = "auto",
         mesh = _smap_mesh()
         if mesh is not None:
             tp = mesh.shape.get("tp", 1)
+            ep = mesh.shape.get("ep", 1)
             if _tp_shardable(np_, d, kind, tp):
                 x2 = _pad_x(x.reshape(rows, n), n, np_)
-                out = _sharded_matmul(x2, qp3, s3, layer, kind, mesh, interp)
+                raw = qt.qt if isinstance(qt, QLayerView) else None
+                if (ep > 1 and raw is not None and raw.qpacked.ndim == 4
+                        and raw.qpacked.shape[1] % ep == 0
+                        and kind in ("row", "col")):
+                    # (L, E, n/2, d) expert stack on an ep mesh: the stack
+                    # is expert-sharded in HBM (place_params) — decode the
+                    # flat index per shard and psum the owner's product
+                    out = _sharded_matmul_ep(x2, raw.qpacked, raw.scales,
+                                             layer, kind, mesh, interp)
+                else:
+                    out = _sharded_matmul(x2, qp3, s3, layer, kind, mesh, interp)
                 return out.reshape(*lead, d).astype(out_dtype)
             key = (kind, np_, d, tp)
             if key not in _FALLBACK_WARNED:

@@ -124,16 +124,12 @@ def place_params(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
     out = {}
     for k, v in params.items():
         spec = specs[k]
-        if (k in ("up", "gate", "down") and mesh.shape.get("ep", 1) > 1
-                and hasattr(v, "qpacked")):
-            # packed-Q40 expert stacks stay expert-replicated: the fused
-            # kernel's scalar-prefetch expert select indexes the full local
-            # stack (ops/q40.py QLayerView); expert-parallel packed MoE
-            # would need a cross-shard select and is not worth the ICI
-            # round at current expert sizes
-            print(f"⚠️  sharding: {k} is packed Q40 — expert axis kept "
-                  "replicated (ep applies to dense expert stacks)")
-            spec = P(*[None if ax == "ep" else ax for ax in spec])
+        # packed-Q40 expert stacks shard the expert axis over ep like their
+        # dense counterparts: the fused kernel's expert select decodes the
+        # flat index per shard and psums the owner's product
+        # (ops/q40.py _sharded_matmul_ep), so quantized MoE weight
+        # residency scales 1/ep — what lets packed Grok-1-314B fit its
+        # 16-chip plan (tools/memory_plan.py, docs/MEMORY.md)
         if not _spec_divides(v, spec, mesh):
             # e.g. a Q40 scales plane (n/32 rows) that doesn't divide the
             # mesh axis: keep the tensor replicated — q40.matmul makes the

@@ -101,6 +101,37 @@ def test_mixtral_q40_mfile_end_to_end(tmp_path):
     assert len(toks) == 8
 
 
+def test_ep_sharded_packed_experts_match_tp1():
+    """Expert-PARALLEL packed experts (ep shards the expert axis of the
+    (L, E, n/2, d) stacks in HBM — q40._sharded_matmul_ep): ep4×tp2 and
+    ep2×tp2 must reproduce the 1-shard logits on both the fused interpret
+    path and the XLA fallback, for prefill and decode.  This is the layout
+    that lets packed Grok-1-314B fit its 16-chip plan (docs/MEMORY.md)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    cfg = tiny_config(arch=mfile.ARCH_MIXTRAL, n_experts=4, n_active_experts=2,
+                      dim=256, hidden_dim=256, n_layers=2, n_heads=8,
+                      n_kv_heads=8, vocab_size=128, seq_len=32,
+                      ).with_(quant_impl="pallas_interpret")
+    qparams = quantize_matmuls(init_params(cfg, seed=4), cfg)
+    prompt = [1, 2, 3]
+    e1 = Engine(cfg, qparams, mesh=make_mesh(tp=1, devices=jax.devices()[:1]))
+    l1, _ = e1.prefill(prompt)
+    d1, _ = e1.decode_one(7)
+    for impl in ("pallas_interpret", "xla"):
+        for ep, tp in ((4, 2), (2, 2)):
+            e = Engine(cfg.with_(quant_impl=impl), qparams,
+                       mesh=make_mesh(tp=tp, ep=ep))
+            le, _ = e.prefill(prompt)
+            np.testing.assert_allclose(
+                l1, le, rtol=0, atol=1e-3 + 1e-3 * np.abs(l1).max(),
+                err_msg=f"prefill impl={impl} ep={ep} tp={tp}")
+            de, _ = e.decode_one(7)
+            np.testing.assert_allclose(
+                d1, de, rtol=0, atol=1e-3 + 1e-3 * np.abs(d1).max(),
+                err_msg=f"decode impl={impl} ep={ep} tp={tp}")
+
+
 def test_tp8_quantized_moe_matches_tp1():
     """N-shard ≡ 1-shard with packed experts on the pallas-interpret
     shard_map path (shard-clean shapes)."""

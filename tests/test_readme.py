@@ -22,6 +22,9 @@ def _full_suite_run(request) -> bool:
     opt = request.config.option
     if getattr(opt, "keyword", "") or getattr(opt, "markexpr", ""):
         return False
+    if getattr(opt, "lf", False) or getattr(opt, "last_failed", False) \
+            or getattr(opt, "deselect", None):
+        return False
     targets = [a for a in request.config.invocation_params.args
                if not a.startswith("-")]
     return all(os.path.abspath(t).rstrip("/") in (REPO, os.path.join(REPO, "tests"))
