@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..io import mfile
-from ..ops import q40
+from ..ops import q40, q8
 from .config import ModelConfig
 
 Params = dict  # pytree: str -> jnp.ndarray | q40.QTensor
@@ -79,11 +79,15 @@ def _stack(mf: mfile.MFile, names: list[str], transpose: bool, dtype) -> np.ndar
     return np.stack(mats).astype(dtype)
 
 
-def _stack_q(mf: mfile.MFile, names: list[str | list[str]]) -> q40.QTensor:
-    """Layer-stack Q40 tensors straight from their packed file bytes —
+def _stack_q(mf: mfile.MFile, names: list[str | list[str]], codec=q40):
+    """Layer-stack quantized tensors straight from their packed file bytes —
     the weights never touch f32 on host (the reference likewise keeps Q40
     end-to-end on its production path, funcs.cpp:287-386); the repack is a
     byte transpose per tensor (native csrc/q40pack.cpp when built).
+
+    ``codec`` is ``ops.q40`` or ``ops.q8`` — the reference dispatches its
+    matmul on the weight file type (funcs.cpp:414-455) and so does the
+    loader here.
 
     An inner list of names concatenates those tensors' output dims into one
     fused weight (e.g. q+k+v), which halves-again the fused kernel's launch
@@ -95,7 +99,7 @@ def _stack_q(mf: mfile.MFile, names: list[str | list[str]]) -> q40.QTensor:
 
     groups = [[entry(g) for g in ([name] if isinstance(name, str) else name)]
               for name in names]
-    return q40.pack_file_groups(groups)
+    return codec.pack_file_groups(groups)
 
 
 def quantize_matmuls(params: Params, cfg: ModelConfig,
@@ -131,24 +135,29 @@ def quantize_matmuls(params: Params, cfg: ModelConfig,
     return out
 
 
-def _stack_q_experts(mf: mfile.MFile, cfg: ModelConfig, fname: str) -> q40.QTensor:
-    """Layer×expert-stacked packed-Q40 expert weights, filled tensor by
-    tensor into preallocated host arrays — no f32 materialization and no
-    transient double-buffering, so host RAM transit is bounded by the
-    packed size (~0.69 B/weight).  Replaces the dense f32 expert loading
-    that made Mixtral-8x7B (~90 GB f32 transit) unloadable (VERDICT r01)."""
+def _stack_q_experts(mf: mfile.MFile, cfg: ModelConfig, fname: str, codec=q40):
+    """Layer×expert-stacked packed expert weights (Q40 or Q80 ``codec``),
+    filled tensor by tensor into preallocated host arrays — no f32
+    materialization and no transient double-buffering, so host RAM transit
+    is bounded by the packed size (~0.69 B/weight for Q40).  Replaces the
+    dense f32 expert loading that made Mixtral-8x7B (~90 GB f32 transit)
+    unloadable (VERDICT r01)."""
     L, E = cfg.n_layers, cfg.n_experts
     t0 = mf.by_name[f"layers.0.experts.0.{fname}"]
     d = int(np.prod(t0.shape[:-1]))
     n = t0.shape[-1]
-    np_ = q40.padded_n(n)
-    qp = np.zeros((L, E, np_ // 2, d), np.uint8)
+    np_ = codec.padded_n(n)
+    qp = codec.alloc_value_plane((L, E), np_, d)
+    cls = codec.Tensor
     sc = np.zeros((L, E, np_ // 32, d), np.float16)
     for l in range(L):
         for e in range(E):
-            q40.repack_file_bytes_into(
+            codec.repack_file_bytes_into(
                 mf.raw(f"layers.{l}.experts.{e}.{fname}"), d, n, qp[l, e], sc[l, e])
-    return q40.QTensor(jnp.asarray(qp), jnp.asarray(sc.view(np.uint16)), (n, d))
+    if not np.isfinite(sc).all():  # same loud-failure rule as pack_file_groups
+        raise ValueError(f"{fname}: expert scale plane contains inf/NaN f16 "
+                         "scales — corrupt or overflowed .m tensor")
+    return cls(jnp.asarray(qp), jnp.asarray(sc.view(np.uint16)), (n, d))
 
 
 def load_params(mf: mfile.MFile, cfg: ModelConfig | None = None,
@@ -161,10 +170,12 @@ def load_params(mf: mfile.MFile, cfg: ModelConfig | None = None,
     places onto the mesh with shardings (upload happens once, sliced by
     XLA, riding PCIe/ICI instead of the reference's TCP star).
 
-    ``keep_quantized=True`` keeps Q40 matmul weights packed for the fused
-    dequant-matmul (ops/q40.py) — the production path, 3.5× the decode
-    bandwidth of dense bf16.  Non-Q40 tensors (norms, embedding, MoE
-    experts) are dequantized either way.
+    ``keep_quantized=True`` keeps Q40/Q80 matmul weights packed for their
+    fused dequant-matmuls (ops/q40.py, ops/q8.py — the reference likewise
+    dispatches its matmul on the weight ftype, funcs.cpp:414-455).  Q40 is
+    the production path (3.5× the decode bandwidth of dense bf16; Q80 is
+    ~1.9×).  Norms, the embedding, and the router are dequantized either
+    way; F16/F32 files always load dense.
 
     ``fuse=True`` concatenates q/k/v (and w1/w3) into single ``wqkv``/
     ``w13`` tensors on the quantized path — right for single-chip decode
@@ -176,18 +187,20 @@ def load_params(mf: mfile.MFile, cfg: ModelConfig | None = None,
     if dtype is None:
         dtype = cfg.dtype
     np_dtype = np.dtype(jnp.dtype(dtype).name) if dtype != jnp.bfloat16 else jnp.bfloat16
-    quant = keep_quantized and mf.spec.weights_ftype == mfile.quants.Q40
+    ftype = mf.spec.weights_ftype
+    quant = keep_quantized and ftype in (mfile.quants.Q40, mfile.quants.Q80)
+    codec = q40 if ftype == mfile.quants.Q40 else q8
     L = cfg.n_layers
     p: Params = {}
     p["embedding"] = mf.tensor("token_embedding").astype(np_dtype)
     if quant and fuse:
         p["wqkv"] = _stack_q(
             mf, [[f"layers.{i}.wq", f"layers.{i}.wk", f"layers.{i}.wv"]
-                 for i in range(L)])
-        p["wo"] = _stack_q(mf, [f"layers.{i}.wo" for i in range(L)])
+                 for i in range(L)], codec)
+        p["wo"] = _stack_q(mf, [f"layers.{i}.wo" for i in range(L)], codec)
     elif quant:
         for key in ("wq", "wk", "wv", "wo"):
-            p[key] = _stack_q(mf, [f"layers.{i}.{key}" for i in range(L)])
+            p[key] = _stack_q(mf, [f"layers.{i}.{key}" for i in range(L)], codec)
     else:
         for key in ("wq", "wk", "wv", "wo"):
             p[key] = _stack(mf, [f"layers.{i}.{key}" for i in range(L)], True, np_dtype)
@@ -197,7 +210,7 @@ def load_params(mf: mfile.MFile, cfg: ModelConfig | None = None,
         p["router"] = _stack(mf, [f"layers.{i}.moe_router" for i in range(L)], True, np_dtype)
         if quant:
             for key in ("up", "gate", "down"):
-                p[key] = _stack_q_experts(mf, cfg, key)
+                p[key] = _stack_q_experts(mf, cfg, key, codec)
         else:
             for key, fname in [("up", "up"), ("gate", "gate"), ("down", "down")]:
                 per_layer = []
@@ -211,21 +224,21 @@ def load_params(mf: mfile.MFile, cfg: ModelConfig | None = None,
             p["rms_ffn2"] = _stack(mf, [f"layers.{i}.rms_ffn2" for i in range(L)], False, np.float32)
     elif quant and fuse:
         p["w13"] = _stack_q(
-            mf, [[f"layers.{i}.w1", f"layers.{i}.w3"] for i in range(L)])
-        p["w2"] = _stack_q(mf, [f"layers.{i}.w2" for i in range(L)])
+            mf, [[f"layers.{i}.w1", f"layers.{i}.w3"] for i in range(L)], codec)
+        p["w2"] = _stack_q(mf, [f"layers.{i}.w2" for i in range(L)], codec)
     elif quant:
         for key in ("w1", "w2", "w3"):
-            p[key] = _stack_q(mf, [f"layers.{i}.{key}" for i in range(L)])
+            p[key] = _stack_q(mf, [f"layers.{i}.{key}" for i in range(L)], codec)
     else:
         for key in ("w1", "w2", "w3"):
             p[key] = _stack(mf, [f"layers.{i}.{key}" for i in range(L)], True, np_dtype)
     p["rms_final"] = mf.tensor("rms_final").astype(np.float32)
     if quant:
         tw = mf.by_name["wcls"]
-        p["wcls"] = q40.pack_file_groups(
+        p["wcls"] = codec.pack_file_groups(
             [[(mf.raw("wcls"), int(np.prod(tw.shape[:-1])), tw.shape[-1])]],
             stacked=False)
     else:
         p["wcls"] = np.ascontiguousarray(mf.tensor("wcls").T).astype(np_dtype)
-    return cfg, {k: v if isinstance(v, q40.QTensor) else jnp.asarray(v)
+    return cfg, {k: v if isinstance(v, (q40.QTensor, q8.Q8Tensor)) else jnp.asarray(v)
                  for k, v in p.items()}

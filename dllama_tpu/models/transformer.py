@@ -29,7 +29,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from ..ops import q40
+from ..ops import q40, q8
 from ..ops.attention import gqa_attention_at, update_kv_cache_at
 from ..ops.kernels import ACTIVATIONS, apply_rope, rmsnorm, rope_angles, softmax_f32
 from ..ops.sp_attention import ring_attention, sp_gqa_attention, sp_update_kv_cache_at
@@ -236,7 +236,8 @@ def run_blocks(params: Params, cfg: ModelConfig, tokens: jax.Array,
     # per-layer copy of the stacked HBM buffer every step; instead the body
     # gets a QLayerView and the fused kernel indexes the stacked buffer
     # directly (scalar-prefetch index_map, ops/q40.py).
-    qt_keys = [k for k in layer_keys if isinstance(params[k], q40.QTensor)]
+    qt_keys = [k for k in layer_keys
+               if isinstance(params[k], (q40.QTensor, q8.Q8Tensor))]
     stacked = {k: params[k] for k in layer_keys if k not in qt_keys}
 
     def block(carry, layer):

@@ -112,6 +112,16 @@ class QTensor:
         return jnp.bfloat16
 
 
+def alloc_value_plane(lead: tuple, np_: int, d: int) -> np.ndarray:
+    """Preallocated host value plane for ``repack_file_bytes_into`` fills
+    (codec-API twin of q8.alloc_value_plane — the loader stays
+    codec-agnostic): Q40 packs two rows per byte."""
+    return np.zeros((*lead, np_ // 2, d), np.uint8)
+
+
+Tensor = QTensor  # codec-generic alias (q8.Tensor = Q8Tensor)
+
+
 def pack_planes_np(qvals: np.ndarray, scales: np.ndarray
                    ) -> tuple[np.ndarray, np.ndarray, tuple[int, int]]:
     """Pack int8 nibble values ``(..., n, d)`` in [-8, 7] + scales
@@ -259,8 +269,11 @@ def split_d(qt: QTensor, sizes: list[int]) -> list[QTensor]:
     n = qt.logical_nd[0]
     out, off = [], 0
     for s in sizes:
-        out.append(QTensor(qt.qpacked[..., :, off:off + s],
-                           qt.scales[..., :, off:off + s], (n, s)))
+        # type(qt): works for Q40 QTensor and Q80 q8.Q8Tensor alike (same
+        # field layout; only the value-plane row count/dtype differ, and
+        # neither is touched by an output-dim slice)
+        out.append(type(qt)(qt.qpacked[..., :, off:off + s],
+                            qt.scales[..., :, off:off + s], (n, s)))
         off += s
     if off != qt.logical_nd[1]:
         raise ValueError(f"split sizes {sizes} != output dim {qt.logical_nd[1]}")
@@ -580,7 +593,9 @@ class QLayerView:
 
     def sliced(self) -> QTensor:
         qp, s = self.flat_planes()
-        return QTensor(
+        # type(self.qt): a view can wrap a Q40 QTensor or a Q80 q8.Q8Tensor
+        # (same field layout); slicing must preserve the codec type
+        return type(self.qt)(
             jax.lax.dynamic_index_in_dim(qp, self.layer, 0, keepdims=False),
             jax.lax.dynamic_index_in_dim(s, self.layer, 0, keepdims=False),
             self.qt.logical_nd)
@@ -855,8 +870,15 @@ def matmul(x: jax.Array, qt: QTensor | QLayerView, impl: str = "auto",
 
 def mm(x: jax.Array, w, impl: str = "auto", out_dtype=None,
        kind: str | None = None) -> jax.Array:
-    """Generic matmul: dispatches QTensor → fused path, array → plain dot."""
-    if isinstance(w, (QTensor, QLayerView)):
-        return matmul(x, w, impl=impl, out_dtype=out_dtype, kind=kind)
+    """Generic matmul: dispatches packed tensors (Q40 or Q80, bare or as a
+    layer view) to their fused path, arrays to a plain dot."""
+    if not isinstance(w, (jax.Array, np.ndarray)):
+        from . import q8
+        base = w.qt if isinstance(w, QLayerView) else w
+        if isinstance(base, q8.Q8Tensor):
+            return q8.matmul(x, w, impl=impl, out_dtype=out_dtype, kind=kind)
+        if isinstance(base, QTensor):
+            return matmul(x, w, impl=impl, out_dtype=out_dtype, kind=kind)
+        raise TypeError(f"mm: unsupported weight type {type(w).__name__}")
     out = x @ w
     return out.astype(out_dtype) if out_dtype is not None else out

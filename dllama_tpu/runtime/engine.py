@@ -48,10 +48,10 @@ def _unfuse(params: Params, cfg: ModelConfig) -> Params:
     """Split fused ``wqkv``/``w13`` tensors into per-projection weights for
     tensor-parallel placement (the fused layout is a single-chip launch
     optimization; its concat axis does not align with TP shard boundaries)."""
-    from ..ops import q40
+    from ..ops import q40, q8
 
     def split(w, sizes):
-        if isinstance(w, q40.QTensor):
+        if isinstance(w, (q40.QTensor, q8.Q8Tensor)):
             return q40.split_d(w, sizes)
         off, out = 0, []
         for s in sizes:

@@ -57,13 +57,28 @@ pytestmark = [
 ]
 
 
+# plain -O2, NOT the reference Makefile's -march=native: with native
+# vectorization the reference's Q80-weights forward reads uninitialized
+# memory and nondeterministically produces all-NaN logits (reproduced on an
+# all-zero Q80 file; its own CI never runs a Q80-weights model end-to-end,
+# and funcs-test only covers the bare kernel).  At -O2 the same binary is
+# deterministic and matches us token-for-token.
+_CC_FLAGS = ["-std=c++11", "-O2"]
+
+
 def _ref_binary() -> str:
-    """Build (once, cached in build/ref) and return the reference dllama."""
+    """Build (once) and return the reference dllama.  The cache is keyed on
+    the compile flags (stamp file): a binary built with different flags —
+    e.g. the pre-fix -march=native one — must never be served."""
     exe = os.path.join(BUILD, "dllama")
-    if os.path.isfile(exe):
+    stamp = os.path.join(BUILD, "flags.txt")
+    want = " ".join(_CC_FLAGS)
+    if os.path.isfile(exe) and os.path.isfile(stamp) \
+            and open(stamp).read() == want:
         return exe
+    shutil.rmtree(BUILD, ignore_errors=True)  # drop stale objects too
     os.makedirs(BUILD, exist_ok=True)
-    cc = ["g++", "-std=c++11", "-O2", "-march=native"]
+    cc = ["g++"] + _CC_FLAGS
     objs = []
     for tu in _TUS:
         obj = os.path.join(BUILD, tu + ".o")
@@ -76,6 +91,8 @@ def _ref_binary() -> str:
                          "-o", exe + ".part"] + objs + ["-lpthread"],
                    check=True, timeout=180)
     os.replace(exe + ".part", exe)
+    with open(stamp, "w") as f:
+        f.write(want)
     return exe
 
 
@@ -115,8 +132,8 @@ def _our_generate(mpath: str, tpath: str, prompt: str, steps: int) -> str:
     return r.stdout.splitlines()[-1]
 
 
-@pytest.mark.parametrize("ftype", [quants.F32, quants.Q40],
-                         ids=["f32-weights", "q40-weights"])
+@pytest.mark.parametrize("ftype", [quants.F32, quants.Q40, quants.Q80],
+                         ids=["f32-weights", "q40-weights", "q80-weights"])
 def test_generate_stream_matches_reference_binary(tmp_path, ftype):
     exe = _ref_binary()
     mpath, tpath = str(tmp_path / "toy.m"), str(tmp_path / "toy.t")
