@@ -349,14 +349,20 @@ def _q40_kernel(xlo_ref, xhi_ref, bsum_ref, qp_ref, s_ref, o_ref, acc_ref, *,
     * ``exact``   — per-block batched dots of the *raw* nibbles (integers
       ≤15, exact in bf16), scales applied per (block, column) in f32
       afterwards; ~2.5 VPU ops/weight and *less* rounding than classic —
-      but its (nb, t, 16)×(nb, 16, td) batched dots stress the MXU with
-      K=16 passes, so its win is hardware-dependent.
+      but its (nb, 16, t)×(nb, 16, td) batched dots stress the MXU with
+      K=16 passes, so its win is hardware-dependent.  For this variant
+      the activation refs hold TRANSPOSED (tn/2, t) planes and
+      ``bsum_ref`` the transposed (nb, tn/2) matrix, so every in-kernel
+      reshape regroups sublanes only (the original (t, tn/2) form needed
+      a lane-dim regroup — an unsupported Mosaic shape cast, which kept
+      this variant interpret-only through r03).
 
-    ``bsum_ref`` is a constant (tn/2, nb) 0/1 matrix (full-array block, so
-    its 32-wide lane dim is legal under Mosaic's block-shape rules, which a
-    (t, tile_n/32) streamed input is not); ``folded``/``exact`` recover the
-    per-block activation sums with two tiny MXU dots instead of a streamed
-    ``xs`` operand.
+    ``bsum_ref`` is a constant (tn/2, nb) 0/1 matrix ((nb, tn/2) for
+    ``exact``; full-array block either way, so its narrow lane dim is
+    legal under Mosaic's block-shape rules, which a (t, tile_n/32)
+    streamed input is not); ``folded``/``exact`` recover the per-block
+    activation sums with two tiny MXU dots instead of a streamed ``xs``
+    operand.
     """
     i = pl.program_id(1)
     qp = qp_ref[...]                                      # (tn/2, td) uint8
@@ -375,18 +381,27 @@ def _q40_kernel(xlo_ref, xhi_ref, bsum_ref, qp_ref, s_ref, o_ref, acc_ref, *,
                 + jnp.dot(xhi_ref[:], b, preferred_element_type=jnp.float32))
 
     if variant == "exact":
+        # Mosaic-legal form (r04 rework; the original regrouped the LANE
+        # dim of (t, tn/2) activations, an unsupported shape cast — see
+        # mosaic-v5e notes): the activation operands arrive TRANSPOSED
+        # (tn/2, t) from _pallas_matmul, so every reshape below splits the
+        # SUBLANE dim only, and ``bsum_ref`` holds the transposed (nb,
+        # tn/2) summing matrix.  The batched dot emits (nb, t, td)
+        # directly — no in-kernel transpose anywhere.
         lo = (vi & 0xF).astype(jnp.bfloat16).reshape(nb, 16, td)
         hi = (vi >> 4).astype(jnp.bfloat16).reshape(nb, 16, td)
-        xlo = xlo_ref[:]                                  # (t, tn/2) bf16
-        t = xlo.shape[0]
-        xlo = xlo.reshape(t, nb, 16).swapaxes(0, 1)       # (nb, t, 16)
-        xhi = xhi_ref[:].reshape(t, nb, 16).swapaxes(0, 1)
+        xloT = xlo_ref[:]                                 # (tn/2, t) bf16
+        xhiT = xhi_ref[:]
         dot = functools.partial(
             jax.lax.dot_general,
-            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            dimension_numbers=(((1,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
-        p = dot(xlo, lo) + dot(xhi, hi)                   # (nb, t, td)
-        corr = p - 8.0 * block_sums().swapaxes(0, 1)[:, :, None]
+        tt = xloT.shape[-1]
+        p = (dot(xloT.reshape(nb, 16, tt), lo)
+             + dot(xhiT.reshape(nb, 16, tt), hi))         # (nb, t, td)
+        bs = (jnp.dot(bsum_ref[:], xloT, preferred_element_type=jnp.float32)
+              + jnp.dot(bsum_ref[:], xhiT, preferred_element_type=jnp.float32))
+        corr = p - 8.0 * bs[:, :, None]                   # bs: (nb, t)
         part = jnp.sum(corr * s32[:, None, :], axis=0)    # (t, td)
     else:
         if variant == "classic":
@@ -491,15 +506,25 @@ def _pallas_matmul(x: jax.Array, qpacked: jax.Array, scales: jax.Array,
     d = qpacked.shape[-1]
     tile_n, tile_d = tiles or _tiles(n, d)
     grid = (pl.cdiv(d, tile_d), n // tile_n)
+    variant = _check_variant(variant)
     x_lo, x_hi = _x_parts(x.astype(jnp.bfloat16))
     bsum = jnp.asarray(_bsum_mat(tile_n))
+    if variant == "exact":
+        # transposed activation planes + transposed summing matrix: lets
+        # the kernel's per-block reshapes regroup sublanes only (the lane
+        # regroup of the original form does not lower under Mosaic)
+        x_lo, x_hi, bsum = x_lo.T, x_hi.T, bsum.T
+        xspec = pl.BlockSpec((tile_n // 2, t), lambda j, i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    else:
+        xspec = pl.BlockSpec((t, tile_n // 2), lambda j, i: (0, i),
+                             memory_space=pltpu.VMEM)
     return pl.pallas_call(
-        functools.partial(_q40_kernel, nsteps=grid[1],
-                          variant=_check_variant(variant)),
+        functools.partial(_q40_kernel, nsteps=grid[1], variant=variant),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((t, tile_n // 2), lambda j, i: (0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((t, tile_n // 2), lambda j, i: (0, i), memory_space=pltpu.VMEM),
+            xspec,
+            xspec,
             pl.BlockSpec(bsum.shape, lambda j, i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((tile_n // 2, tile_d), lambda j, i: (i, j), memory_space=pltpu.VMEM),
             pl.BlockSpec((tile_n // 32, tile_d), lambda j, i: (i, j), memory_space=pltpu.VMEM),
@@ -530,17 +555,23 @@ def _pallas_matmul_stacked(x: jax.Array, qpacked: jax.Array, scales: jax.Array,
     d = qpacked.shape[-1]
     tile_n, tile_d = _tiles(n, d)
     grid = (pl.cdiv(d, tile_d), n // tile_n)
+    variant = _check_variant(variant)
     x_lo, x_hi = _x_parts(x.astype(jnp.bfloat16))
     bsum = jnp.asarray(_bsum_mat(tile_n))
+    if variant == "exact":  # transposed operands — see _pallas_matmul
+        x_lo, x_hi, bsum = x_lo.T, x_hi.T, bsum.T
+        xspec = pl.BlockSpec((tile_n // 2, t), lambda j, i, l: (i, 0))
+    else:
+        xspec = pl.BlockSpec((t, tile_n // 2), lambda j, i, l: (0, i))
     out = pl.pallas_call(
         functools.partial(_stacked_q40_kernel, nsteps=grid[1],
-                          variant=_check_variant(variant)),
+                          variant=variant),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((t, tile_n // 2), lambda j, i, l: (0, i)),
-                pl.BlockSpec((t, tile_n // 2), lambda j, i, l: (0, i)),
+                xspec,
+                xspec,
                 pl.BlockSpec(bsum.shape, lambda j, i, l: (0, 0)),
                 pl.BlockSpec((1, tile_n // 2, tile_d), lambda j, i, l: (l[0], i, j)),
                 pl.BlockSpec((1, tile_n // 32, tile_d), lambda j, i, l: (l[0], i, j)),

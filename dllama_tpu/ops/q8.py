@@ -10,9 +10,12 @@ This module closes that gap the TPU way, mirroring ops/q40.py:
 * ``Q8Tensor`` — int8 value plane ``(..., padded_n, d)`` + f16-bit scale
   plane ``(..., padded_n/32, d)``, input-dim-major so a (tile_n, tile_d)
   tile is contiguous per output column, same as the Q40 planes;
-* a Pallas kernel that widens int8 → f32, applies the per-block scale,
-  rounds to bf16 (exactly the file codec's dequant, quants.py:162-171)
-  and feeds the MXU, accumulating over reduction tiles in VMEM;
+* a Pallas kernel that widens int8 → f32, applies the per-block scale
+  (the file codec's math, quants.py:162-171), rounds the product to bf16
+  for the MXU — one more round than the codec's f32 dequant, the same
+  policy as the q40 classic variant — and accumulates reduction tiles in
+  VMEM; q8.dequantize applies the identical round so kernel and XLA
+  emulation agree bit-for-bit;
 * a layer-stacked variant with the layer index as scalar prefetch, so
   the ``lax.scan`` over layers DMAs tiles straight from the stacked HBM
   buffer (no per-layer slice materialization — see q40.py:494-506);
@@ -330,6 +333,13 @@ def matmul(x: jax.Array, qt: Q8Tensor | QLayerView, impl: str = "auto",
     if impl not in ("xla", "pallas", "pallas_interpret"):
         raise ValueError(f"unknown q8 matmul impl {impl!r} "
                          "(expected auto | xla | pallas | pallas_interpret)")
+    if impl != "xla" and _smap_mesh() is not None:
+        key = ("q8-mesh", qt.logical_nd)
+        if key not in q40._FALLBACK_WARNED:
+            q40._FALLBACK_WARNED.add(key)
+            print(f"⚠️  q8: {qt.logical_nd} requested impl={impl!r} on a "
+                  "multi-device mesh; Q80 runs the GSPMD XLA path there "
+                  "(see module docstring)")
     # XLA path (meshes, CPU, probe failure)
     base = qt.sliced() if is_view else qt
     w = dequantize(base, dtype=jnp.bfloat16)

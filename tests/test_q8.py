@@ -8,14 +8,12 @@ integration (Q80 `.m` → packed planes, no dense transit), and model-level
 equivalence against the dense-load path.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from dllama_tpu import quants
 from dllama_tpu.io import mfile
-from dllama_tpu.models.config import tiny_config
 from dllama_tpu.models.params import load_params
 from dllama_tpu.ops import q40, q8
 from fixtures import write_tiny_model
