@@ -149,11 +149,17 @@ def _seed(args) -> int:
     return args.seed if args.seed is not None else int(time.time())
 
 
+def _encode_prompt(engine, tok, prompt: str) -> list[int]:
+    """Prompt encoding with the reference's BOS rule (ModelConfig.add_bos:
+    Grok-1 prompts get no BOS, dllama.cpp:27)."""
+    return tok.encode(prompt, add_bos=engine.cfg.add_bos)
+
+
 def cmd_inference(args) -> None:
     """Benchmark mode (dllama.cpp:45-93): prints per-token G/I/T."""
     engine, tok = load_stack(args)
     prompt = args.prompt or "Hello world"
-    ids = tok.encode(prompt, add_bos=True)
+    ids = _encode_prompt(engine, tok, prompt)
     steps = args.steps or 64
     if args.chunk > 1:
         print(f"💡 decode runs on-device in chunks of {args.chunk}; G/I/T "
@@ -214,7 +220,7 @@ def cmd_generate(args) -> None:
     engine, tok = load_stack(args)
     if args.prompt is None:
         raise SystemExit("generate mode requires --prompt")
-    ids = tok.encode(args.prompt, add_bos=True)
+    ids = _encode_prompt(engine, tok, args.prompt)
     steps = args.steps or engine.seq_len
     prev = tok.bos_id
     eos = (tok.eos_id,) if tok.eos_id >= 0 else ()

@@ -66,6 +66,12 @@ class ModelConfig:
         return self.arch == mfile.ARCH_LLAMA
 
     @property
+    def add_bos(self) -> bool:
+        """Whether prompts get a BOS token (reference: dllama.cpp:27 —
+        Grok-1 prompts are encoded without BOS; chat mode always adds it)."""
+        return self.arch != mfile.ARCH_GROK1
+
+    @property
     def embedding_scale(self) -> float:
         return GROK_EMBEDDING_SCALE if self.arch == mfile.ARCH_GROK1 else 1.0
 
