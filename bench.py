@@ -618,7 +618,7 @@ def main():
         # log — one remote compile per config
         if chunk_out and remaining() > RESERVE + 320 and _relay_up():
             here = os.path.dirname(os.path.abspath(__file__))
-            for tn, td in ((1024, 1024), (512, 2048)):
+            for tn, td in ((1024, 1024), (512, 2048), (512, 4096)):
                 if remaining() < RESERVE + 60:
                     break
                 try:

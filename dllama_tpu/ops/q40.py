@@ -479,12 +479,36 @@ def _check_variant(variant: str | None) -> str:
     return v
 
 
+def _tile_rules() -> list[tuple[int, int, int]]:
+    """Width-aware tile overrides, highest d_min first: ``(d_min, tn, td)``
+    applies to weights with output width ≥ d_min.
+
+    Motivation (docs/PERF.md lever #1): a (tn/2, td) tile of the row-major
+    packed plane is td contiguous bytes per row, so td sets the HBM burst
+    length — and measured per-shape kernel bandwidth falls with d (wo at
+    d=4096 streams ~632 GB/s, w13 at 22016 only ~354).  The rule table is
+    data-driven (env ``DLLAMA_Q40_TILES_JSON``, e.g. ``[[8192,512,2048]]``)
+    so the hardware sweep (tools/sweep_q40.py; bench.py probes two configs
+    every run) can flip defaults without a code edit; empty until a
+    driver-verified measurement lands."""
+    s = os.environ.get("DLLAMA_Q40_TILES_JSON", "")
+    if not s:
+        return []
+    import json
+    return sorted(((int(a), int(b), int(c)) for a, b, c in json.loads(s)),
+                  reverse=True)
+
+
 def _tiles(n: int, d: int) -> tuple[int, int]:
     """Pick reduction/output tile sizes; the ragged last D tile is masked
     on store.  Pack-time padding makes n a TILE_N multiple for whole
     tensors; a TP shard's local n may be a smaller power-of-two multiple
     (padded_n/tp), so fall down the divisor ladder rather than taking the
     whole axis as one tile (which would blow VMEM at 7B shapes)."""
+    for d_min, tn, td in _tile_rules():
+        # tn ≥ 256 keeps the scales operand's sublane count ≥ 8 (Mosaic)
+        if d >= d_min and tn >= 256 and n % tn == 0:
+            return tn, td
     tile_n = n
     for tn in (TILE_N, TILE_N // 2, TILE_N // 4, TILE_N // 8, TILE_N // 16, 32):
         if n % tn == 0:

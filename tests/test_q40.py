@@ -242,6 +242,33 @@ class TestShardMap:
         assert t1 == t8
 
 
+class TestTileRules:
+    def test_width_aware_override_applies(self, monkeypatch):
+        """DLLAMA_Q40_TILES_JSON routes wide-output shapes to bigger td
+        (docs/PERF.md lever #1) without touching narrow shapes; illegal
+        rules (tn<256 or non-dividing tn) are skipped."""
+        monkeypatch.setenv("DLLAMA_Q40_TILES_JSON", "[[8192, 512, 2048]]")
+        assert q40._tiles(4096, 22016) == (512, 2048)   # w13: rule hits
+        assert q40._tiles(4096, 4096) == (1024, 1024)   # wo: below d_min
+        monkeypatch.setenv("DLLAMA_Q40_TILES_JSON", "[[0, 128, 2048]]")
+        assert q40._tiles(4096, 22016) == (1024, 1024)  # tn<256 → ignored
+        monkeypatch.setenv("DLLAMA_Q40_TILES_JSON", "[[0, 768, 2048]]")
+        assert q40._tiles(4096, 22016) == (1024, 1024)  # 4096%768 → ignored
+        monkeypatch.delenv("DLLAMA_Q40_TILES_JSON")
+        assert q40._tiles(4096, 22016) == (1024, 1024)  # default unchanged
+
+    def test_kernel_correct_at_rule_tiles(self):
+        """Numerics hold at the hypothesis tile class (512, 2048)."""
+        rng = np.random.RandomState(0)
+        w = (rng.randn(1024, 2048) * 0.1).astype(np.float32)
+        qt = q40.quantize(w)
+        x = jnp.asarray(rng.randn(1, 1024).astype(np.float32), jnp.bfloat16)
+        out = np.asarray(q40._pallas_matmul(x, qt.qpacked, qt.scales,
+                                            interpret=True, tiles=(512, 2048)))
+        ref = np.asarray(x @ q40.dequantize(qt, jnp.bfloat16))
+        np.testing.assert_allclose(out, ref, rtol=0, atol=1e-2 * np.abs(ref).max())
+
+
 class TestScaleValidation:
     def test_inf_scale_in_file_bytes_rejected(self):
         """A converter-overflowed or corrupt scale (f16 inf/NaN) must fail

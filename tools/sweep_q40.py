@@ -48,7 +48,10 @@ def shapes():
 # scales block spec needs tn/32 ≥ 8 sublanes).
 CONFIGS = [
     ("classic", 1024, 1024), ("fma", 1024, 1024), ("folded", 1024, 1024),
-    ("classic", 512, 2048), ("folded", 512, 2048),
+    # exact is Mosaic-legal by construction since the r04 transposed-
+    # operand rework (q40.py _q40_kernel) — measure it on hardware
+    ("exact", 1024, 1024),
+    ("classic", 512, 2048), ("folded", 512, 2048), ("exact", 512, 2048),
     ("classic", 256, 4096), ("folded", 256, 4096),
     ("classic", 512, 4096),
     ("classic", 256, 2048),
