@@ -488,9 +488,9 @@ def _tile_rules() -> list[tuple[int, int, int]]:
     length — and measured per-shape kernel bandwidth falls with d (wo at
     d=4096 streams ~632 GB/s, w13 at 22016 only ~354).  The rule table is
     data-driven (env ``DLLAMA_Q40_TILES_JSON``, e.g. ``[[8192,512,2048]]``)
-    so the hardware sweep (tools/sweep_q40.py; bench.py probes two configs
-    every run) can flip defaults without a code edit; empty until a
-    driver-verified measurement lands."""
+    so the hardware sweep (tools/sweep_q40.py; bench.py probes a few tile
+    configs every run) can flip defaults without a code edit; empty until
+    a driver-verified measurement lands."""
     s = os.environ.get("DLLAMA_Q40_TILES_JSON", "")
     if not s:
         return []
@@ -506,8 +506,11 @@ def _tiles(n: int, d: int) -> tuple[int, int]:
     (padded_n/tp), so fall down the divisor ladder rather than taking the
     whole axis as one tile (which would blow VMEM at 7B shapes)."""
     for d_min, tn, td in _tile_rules():
-        # tn ≥ 256 keeps the scales operand's sublane count ≥ 8 (Mosaic)
-        if d >= d_min and tn >= 256 and n % tn == 0:
+        # tn ≥ 256 keeps the scales operand's sublane count ≥ 8 (Mosaic);
+        # td must be a positive lane-dim multiple — malformed rules are
+        # skipped, not applied
+        if d >= d_min and tn >= 256 and n % tn == 0 \
+                and td >= 128 and td % 128 == 0:
             return tn, td
     tile_n = n
     for tn in (TILE_N, TILE_N // 2, TILE_N // 4, TILE_N // 8, TILE_N // 16, 32):

@@ -254,6 +254,8 @@ class TestTileRules:
         assert q40._tiles(4096, 22016) == (1024, 1024)  # tn<256 → ignored
         monkeypatch.setenv("DLLAMA_Q40_TILES_JSON", "[[0, 768, 2048]]")
         assert q40._tiles(4096, 22016) == (1024, 1024)  # 4096%768 → ignored
+        monkeypatch.setenv("DLLAMA_Q40_TILES_JSON", "[[0, 512, 100]]")
+        assert q40._tiles(4096, 22016) == (1024, 1024)  # td%128 → ignored
         monkeypatch.delenv("DLLAMA_Q40_TILES_JSON")
         assert q40._tiles(4096, 22016) == (1024, 1024)  # default unchanged
 

@@ -124,6 +124,11 @@ def measure_one(variant: str, reps: int = 32, only: set | None = None) -> dict:
 
 
 def main():
+    # a deployed width-rule table would silently override the tiles under
+    # test (every swept config would measure the rule's tiles and the sweep
+    # could never contradict the current rules) — the sweep measures the
+    # explicit DLLAMA_Q40_TILE_N/TILE_D ladder only
+    os.environ.pop("DLLAMA_Q40_TILES_JSON", None)
     if len(sys.argv) > 1 and sys.argv[1] == "--one":
         argv = sys.argv[2:]
         only = None
