@@ -56,4 +56,36 @@ void q40_repack(const uint8_t* raw, int64_t d, int64_t nb,
   }
 }
 
+// Q80 twin (ops/q8.py layout): a file block for output row dd covering
+// input positions [32b, 32b+32) is 34 bytes — f16 scale + 32 int8 values,
+// stored to
+//   qv int8  (padded_n, d)    row 32b+r = file value byte r of block b
+//   sc f16   (padded_n/32, d)
+// Same blocked byte transpose, twice the value rows per block.
+void q80_repack(const uint8_t* raw, int64_t d, int64_t nb,
+                int8_t* qv, uint16_t* sc, int64_t ld, int64_t col) {
+  constexpr int64_t kBlockBytes80 = 34;
+#pragma omp parallel for schedule(static)
+  for (int64_t b0 = 0; b0 < nb; b0 += kTileB) {
+    const int64_t b1 = (b0 + kTileB < nb) ? b0 + kTileB : nb;
+    for (int64_t d0 = 0; d0 < d; d0 += kTileD) {
+      const int64_t d1 = (d0 + kTileD < d) ? d0 + kTileD : d;
+      for (int64_t b = b0; b < b1; ++b) {
+        int8_t* qrow0 = qv + (b * 32) * ld + col;
+        uint16_t* srow = sc + b * ld + col;
+        for (int64_t dd = d0; dd < d1; ++dd) {
+          const uint8_t* blk = raw + (dd * nb + b) * kBlockBytes80;
+          uint16_t s;
+          std::memcpy(&s, blk, 2);
+          srow[dd] = s;
+          const int8_t* vals = reinterpret_cast<const int8_t*>(blk + 2);
+          for (int64_t r = 0; r < 32; ++r) {
+            qrow0[r * ld + dd] = vals[r];
+          }
+        }
+      }
+    }
+  }
+}
+
 }  // extern "C"

@@ -29,13 +29,16 @@ def _load_lib(path: str):
     on the fast path; any failure falls back to the Python path silently."""
     if os.environ.get("DLLAMA_NO_NATIVE"):
         return None
-    if not os.path.exists(path):
-        import subprocess
-        try:
-            subprocess.run(["make", "-C", _CSRC], capture_output=True,
-                           timeout=60, check=False)
-        except Exception:
-            pass
+    # run make unconditionally (a no-op when the .so is newer than its
+    # source): a stale library from before a source change would otherwise
+    # silently miss symbols forever — make's own dependency tracking is the
+    # staleness check
+    import subprocess
+    try:
+        subprocess.run(["make", "-C", _CSRC], capture_output=True,
+                       timeout=60, check=False)
+    except Exception:
+        pass
     try:
         return ctypes.CDLL(path)
     except OSError:
@@ -51,6 +54,9 @@ def _lib():
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
     lib.q40_repack.restype = None
+    if hasattr(lib, "q80_repack"):  # absent in a pre-r04 cached .so
+        lib.q80_repack.argtypes = lib.q40_repack.argtypes
+        lib.q80_repack.restype = None
     return lib
 
 
@@ -148,4 +154,43 @@ def q40_repack_into(raw: np.ndarray, d: int, n: int,
     lib.q40_repack(
         raw.ctypes.data_as(ctypes.c_void_p), d, nb,
         qp.ctypes.data_as(ctypes.c_void_p),
+        sc.ctypes.data_as(ctypes.c_void_p), ld, col)
+
+
+def have_native_q80() -> bool:
+    lib = _lib()
+    return lib is not None and hasattr(lib, "q80_repack")
+
+
+def q80_repack_into(raw: np.ndarray, d: int, n: int,
+                    qv: np.ndarray, sc: np.ndarray, col: int) -> None:
+    """Repack one (d, n) Q80 tensor's file bytes into preallocated runtime
+    planes at column offset ``col`` (csrc q80_repack — the Q80 twin of
+    :func:`q40_repack_into`).
+
+    ``qv`` is int8 (padded_n, ld), ``sc`` float16 (padded_n/32, ld); rows
+    beyond n's blocks must be pre-zeroed by the caller (pack padding).
+    """
+    lib = _lib()
+    if lib is None or not hasattr(lib, "q80_repack"):
+        raise RuntimeError("native q80_repack unavailable "
+                           "(make -C dllama_tpu/csrc)")
+    nb = n // 32
+    if raw.nbytes != d * nb * 34:
+        raise ValueError(f"raw size {raw.nbytes} != {d * nb * 34}")
+    if not (qv.flags.c_contiguous and sc.flags.c_contiguous):
+        raise ValueError("output planes must be C-contiguous")
+    if qv.dtype != np.int8 or sc.dtype != np.float16:
+        raise ValueError("qv must be int8, sc float16")
+    ld = qv.shape[-1]
+    if sc.shape[-1] != ld or col + d > ld:
+        raise ValueError(f"column window [{col}, {col + d}) exceeds ld={ld}")
+    if qv.shape[0] < nb * 32 or sc.shape[0] < nb or qv.shape[0] != 32 * sc.shape[0]:
+        raise ValueError(
+            f"plane rows (qv {qv.shape[0]}, sc {sc.shape[0]}) too small for "
+            f"{nb} blocks — the native write would run out of bounds")
+    raw = np.ascontiguousarray(raw)
+    lib.q80_repack(
+        raw.ctypes.data_as(ctypes.c_void_p), d, nb,
+        qv.ctypes.data_as(ctypes.c_void_p),
         sc.ctypes.data_as(ctypes.c_void_p), ld, col)

@@ -509,7 +509,7 @@ def _tiles(n: int, d: int) -> tuple[int, int]:
         # tn ≥ 256 keeps the scales operand's sublane count ≥ 8 (Mosaic);
         # td must be a positive lane-dim multiple — malformed rules are
         # skipped, not applied
-        if d >= d_min and tn >= 256 and n % tn == 0 \
+        if d >= d_min and tn >= 256 and tn % 32 == 0 and n % tn == 0 \
                 and td >= 128 and td % 128 == 0:
             return tn, td
     tile_n = n

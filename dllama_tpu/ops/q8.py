@@ -118,8 +118,14 @@ def repack_file_bytes_into(raw: np.ndarray, d: int, n: int,
                            qv2: np.ndarray, sc2: np.ndarray, col: int = 0) -> None:
     """One (d, n) tensor's `.m` Q80 bytes → preallocated runtime planes
     (``qv2`` int8 (padded_n, ld), ``sc2`` f16 (padded_n/32, ld)) at output
-    column ``col`` — a pure byte transpose (BlockQ80, quants.hpp:22-25)."""
+    column ``col`` — a pure byte transpose (BlockQ80, quants.hpp:22-25);
+    native single pass (csrc q80_repack) when built, numpy otherwise."""
+    from ..native import have_native_q80, q80_repack_into
+
     nb = n // 32
+    if have_native_q80():
+        q80_repack_into(raw, d, n, qv2, sc2, col)
+        return
     blocks = np.asarray(raw, np.uint8).reshape(d, nb, quants.Q80_BLOCK_BYTES)
     sc2[:nb, col:col + d] = (
         np.ascontiguousarray(blocks[:, :, :2]).view(np.float16).reshape(d, nb).T)

@@ -85,3 +85,52 @@ def test_pack_file_groups_end_to_end(tmp_path):
         [mf.tensor("layers.0.wq").T, mf.tensor("layers.0.wk").T,
          mf.tensor("layers.0.wv").T], axis=1)
     np.testing.assert_allclose(wqkv[0], expect, atol=1e-7)
+
+
+@pytest.mark.skipif(not native.have_native_q80(), reason="q80_repack not built")
+def test_native_q80_repack_matches_numpy():
+    """csrc q80_repack ≡ the numpy byte transpose, including column-offset
+    fused-group writes (Q80 twin of the q40 native-loader tests)."""
+    import unittest.mock as mock
+
+    from dllama_tpu.ops import q8
+
+    rng = np.random.RandomState(7)
+    for d, n in [(48, 96), (64, 2048), (129, 32), (100, 352)]:
+        w = (rng.randn(d, n) * 0.2).astype(np.float32)
+        raw = np.frombuffer(quants.quantize_tensor(w, quants.Q80), np.uint8)
+        np_ = q40.padded_n(n)
+        planes = []
+        for use_native in (True, False):
+            qv = np.zeros((np_, d), np.int8)
+            sc = np.zeros((np_ // 32, d), np.float16)
+            if use_native:
+                native.q80_repack_into(raw, d, n, qv, sc, 0)
+            else:
+                # the PRODUCTION numpy branch, not a private copy: force
+                # q8.repack_file_bytes_into down its fallback path
+                with mock.patch.object(native, "have_native_q80",
+                                       return_value=False):
+                    q8.repack_file_bytes_into(raw, d, n, qv, sc, 0)
+            planes.append((qv, sc))
+        np.testing.assert_array_equal(planes[0][0], planes[1][0])
+        np.testing.assert_array_equal(planes[0][1], planes[1][1])
+
+    # column-offset fused write + value correctness via dequantize
+    d1, d2, n = 32, 48, 64
+    w1 = (rng.randn(d1, n) * 0.2).astype(np.float32)
+    w2 = (rng.randn(d2, n) * 0.2).astype(np.float32)
+    r1 = np.frombuffer(quants.quantize_tensor(w1, quants.Q80), np.uint8)
+    r2 = np.frombuffer(quants.quantize_tensor(w2, quants.Q80), np.uint8)
+    np_ = q40.padded_n(n)
+    qv = np.zeros((np_, d1 + d2), np.int8)
+    sc = np.zeros((np_ // 32, d1 + d2), np.float16)
+    native.q80_repack_into(r1, d1, n, qv, sc, 0)
+    native.q80_repack_into(r2, d2, n, qv, sc, d1)
+    import jax.numpy as jnp
+    qt = q8.Q8Tensor(jnp.asarray(qv), jnp.asarray(sc.view(np.uint16)), (n, d1 + d2))
+    deq = np.asarray(q8.dequantize(qt, jnp.float32))
+    np.testing.assert_allclose(
+        deq[:, :d1], quants.dequantize_q80(r1, d1 * n).reshape(d1, n).T, atol=1e-6)
+    np.testing.assert_allclose(
+        deq[:, d1:], quants.dequantize_q80(r2, d2 * n).reshape(d2, n).T, atol=1e-6)
