@@ -156,6 +156,54 @@ def test_generate_stream_matches_reference_binary(tmp_path, ftype):
     assert len(gen) > len("hello hi") + 20, gen
 
 
+def test_chat_turn_matches_reference_binary(tmp_path):
+    """Chat-mode parity: chatml template rendering (tokenizer.cpp:447-465),
+    prompt prefill across the template, streaming EOS holdback, and the
+    context-end stop all reproduce the reference's first assistant turn
+    byte-for-byte at temperature 0 (dllama.cpp:111-203)."""
+    exe = _ref_binary()
+    mpath, tpath = str(tmp_path / "toy.m"), str(tmp_path / "toy.t")
+    spec = mfile.ModelSpec(
+        arch=mfile.ARCH_LLAMA, dim=256, hidden_dim=512, n_layers=2, n_heads=4,
+        n_kv_heads=2, n_experts=0, n_active_experts=0, vocab_size=128,
+        seq_len=256, hidden_act=mfile.ACT_SILU, rope_theta=10000.0,
+        weights_ftype=quants.F32)
+    rng = np.random.RandomState(3)
+    with mfile.MFileWriter(mpath, spec) as w:
+        for t in w.plan:
+            w.write_tensor(t.name, (rng.randn(*t.shape) * 0.05).astype(np.float32))
+    write_tiny_tokenizer(tpath, vocab_size=128)
+    stdin = "sys prompt here\nhello hi\n"
+
+    def turn(out: str) -> str:
+        assert "🤖 Assistant" in out, out
+        body = out.split("🤖 Assistant", 1)[1]
+        for stop in ("(end of context)", "👱 User"):
+            body = body.split(stop, 1)[0]
+        return body.strip()
+
+    # the reference's chat REPL busy-loops on stdin EOF, but a turn that
+    # fills the context makes it exit on its own (dllama.cpp:189-191), so
+    # communicate() terminates once generation hits seq_len
+    r = subprocess.run(
+        [exe, "chat", "--model", mpath, "--tokenizer", tpath,
+         "--temperature", "0", "--seed", "1", "--nthreads", "1",
+         "--buffer-float-type", "f32"],
+        input=stdin, capture_output=True, text=True, timeout=300)
+    ref_turn = turn(r.stdout)
+
+    from fixtures import run_cli
+    ours = run_cli(["chat", "--model", mpath, "--tokenizer", tpath,
+                    "--temperature", "0", "--seed", "1",
+                    "--buffer-float-type", "f32", "--chunk", "8"],
+                   input_text=stdin)
+    assert ours.returncode == 0, ours.stdout + ours.stderr
+    our_turn = turn(ours.stdout)
+
+    assert len(our_turn) > 200, our_turn  # a real multi-hundred-token turn
+    assert our_turn == ref_turn
+
+
 @pytest.mark.parametrize("arch", [mfile.ARCH_MIXTRAL, mfile.ARCH_GROK1],
                          ids=["mixtral", "grok1"])
 def test_moe_archs_match_reference_binary(tmp_path, arch):
