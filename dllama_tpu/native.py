@@ -29,16 +29,31 @@ def _load_lib(path: str):
     on the fast path; any failure falls back to the Python path silently."""
     if os.environ.get("DLLAMA_NO_NATIVE"):
         return None
-    # run make unconditionally (a no-op when the .so is newer than its
-    # source): a stale library from before a source change would otherwise
-    # silently miss symbols forever — make's own dependency tracking is the
-    # staleness check
-    import subprocess
-    try:
-        subprocess.run(["make", "-C", _CSRC], capture_output=True,
-                       timeout=60, check=False)
-    except Exception:
-        pass
+    # rebuild when missing OR older than any csrc source (a stale library
+    # from before a source change would silently miss symbols forever).
+    # The build is serialized with an flock and the Makefile publishes via
+    # rename, so concurrent processes (multihost tests, bench subprocesses)
+    # never dlopen a half-written ELF — and fresh libraries skip the make
+    # exec entirely.
+    def _stale() -> bool:
+        if not os.path.exists(path):
+            return True
+        so_mtime = os.path.getmtime(path)
+        return any(f.endswith(".cpp") and
+                   os.path.getmtime(os.path.join(_CSRC, f)) > so_mtime
+                   for f in os.listdir(_CSRC))
+
+    if _stale():
+        import subprocess
+        try:
+            import fcntl
+            with open(os.path.join(_CSRC, ".build.lock"), "w") as lock:
+                fcntl.flock(lock, fcntl.LOCK_EX)
+                if _stale():  # another process may have built meanwhile
+                    subprocess.run(["make", "-C", _CSRC], capture_output=True,
+                                   timeout=60, check=False)
+        except Exception:
+            pass
     try:
         return ctypes.CDLL(path)
     except OSError:

@@ -507,10 +507,13 @@ def _tiles(n: int, d: int) -> tuple[int, int]:
     whole axis as one tile (which would blow VMEM at 7B shapes)."""
     for d_min, tn, td in _tile_rules():
         # tn ≥ 256 keeps the scales operand's sublane count ≥ 8 (Mosaic);
-        # td must be a positive lane-dim multiple — malformed rules are
-        # skipped, not applied
+        # td must be a positive lane-dim multiple; tn·td is capped so the
+        # working set fits VMEM for BOTH kernels sharing this ladder (q8's
+        # int8 value tile is tn·td bytes — 2× q40's packed tile — plus
+        # bf16 dequant temporaries; 4 Mi elements ≈ 12 MB worst case
+        # against ~16 MB VMEM).  Malformed rules are skipped, not applied.
         if d >= d_min and tn >= 256 and tn % 32 == 0 and n % tn == 0 \
-                and td >= 128 and td % 128 == 0:
+                and td >= 128 and td % 128 == 0 and tn * td <= 4 * 1024 * 1024:
             return tn, td
     tile_n = n
     for tn in (TILE_N, TILE_N // 2, TILE_N // 4, TILE_N // 8, TILE_N // 16, 32):

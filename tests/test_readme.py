@@ -23,7 +23,8 @@ def _full_suite_run(request) -> bool:
     if getattr(opt, "keyword", "") or getattr(opt, "markexpr", ""):
         return False
     if getattr(opt, "lf", False) or getattr(opt, "last_failed", False) \
-            or getattr(opt, "deselect", None):
+            or getattr(opt, "deselect", None) or getattr(opt, "ignore", None) \
+            or getattr(opt, "ignore_glob", None):
         return False
     targets = [a for a in request.config.invocation_params.args
                if not a.startswith("-")]
