@@ -78,6 +78,28 @@ def test_convert_hf_q40_close_to_f32(hf_model_dir, tmp_path):
     assert np.abs(a - b).max() < np.abs(a).max() / 7  # 4-bit step bound
 
 
+def test_convert_hf_q80_loads_packed(hf_model_dir, tmp_path):
+    """HF → Q80 `.m` → the packed Q8 loader path (reference ftype-dispatch
+    parity end-to-end through the converter)."""
+    import convert_hf
+    import jax.numpy as jnp
+
+    from dllama_tpu.ops import q8
+
+    folder, _ = hf_model_dir
+    q80_path = str(tmp_path / "q80.m")
+    convert_hf.convert(folder, quants.Q80, q80_path)
+    mf = mfile.MFile(q80_path)
+    assert mf.spec.weights_ftype == quants.Q80
+    # 8-bit codec: much tighter than the Q40 bound
+    a = mf.tensor("layers.0.wq")
+    cfg, params = load_params(mf, keep_quantized=True)
+    assert isinstance(params["wqkv"], q8.Q8Tensor)
+    w = np.asarray(q8.dequantize(params["wqkv"], jnp.float32))
+    np.testing.assert_allclose(w[:, :cfg.dim], a.reshape(cfg.dim, cfg.dim).T,
+                               rtol=0, atol=1e-6)
+
+
 def test_convert_llama_meta_checkpoint(tmp_path):
     import torch
     import convert_llama
