@@ -95,7 +95,9 @@ def test_convert_hf_q80_loads_packed(hf_model_dir, tmp_path):
     a = mf.tensor("layers.0.wq")
     cfg, params = load_params(mf, keep_quantized=True)
     assert isinstance(params["wqkv"], q8.Q8Tensor)
-    w = np.asarray(q8.dequantize(params["wqkv"], jnp.float32))
+    # layer-stacked fused (L, n, q|k|v): layer 0's q slice must equal the
+    # file tensor's dequant exactly (same codec, pure byte transpose)
+    w = np.asarray(q8.dequantize(params["wqkv"], jnp.float32))[0]
     np.testing.assert_allclose(w[:, :cfg.dim], a.reshape(cfg.dim, cfg.dim).T,
                                rtol=0, atol=1e-6)
 
