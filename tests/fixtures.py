@@ -55,6 +55,14 @@ def write_tiny_tokenizer(path, vocab_size=300) -> tfile.TokenizerData:
     return t
 
 
+def free_port() -> int:
+    """An OS-assigned free TCP port (shared by every server-spawning test)."""
+    import socket
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
 def cpu_env(n_devices: int = 1) -> dict:
     """Subprocess env that actually selects the CPU backend (shared recipe,
     see dllama_tpu/hostenv.py)."""

@@ -16,20 +16,13 @@ mesh must be numerically invisible.
 
 from __future__ import annotations
 
-import socket
 import subprocess
 import sys
 
 import pytest
 
-from fixtures import cpu_env, REPO, write_tiny_model, write_tiny_tokenizer
+from fixtures import cpu_env, free_port, REPO, write_tiny_model, write_tiny_tokenizer
 from dllama_tpu import quants
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
 
 
 def _cmd(mode: str, mpath: str, tpath: str, extra: list[str]) -> list[str]:
@@ -56,7 +49,7 @@ def test_nproc2_generate_matches_single_process(tmp_path):
 
     # nproc=2: same command on both processes + coordinates; proc 1 runs
     # `worker --program generate` (the reference's worker role)
-    port = _free_port()
+    port = free_port()
     coords = ["--coordinator", f"localhost:{port}", "--nproc", "2"]
     p1 = subprocess.Popen(
         _cmd("worker", mpath, tpath,
