@@ -571,6 +571,61 @@ def main():
                 extras["llama3-8b_toks"] = l3_out["value"]
                 print(f"bench: north-star config: {json.dumps(l3_out)}",
                       file=sys.stderr)
+        # --- tile probe + auto-tune (docs/PERF.md lever #1): time the w13
+        # shape at three tile configs; if a wider-td config clearly beats
+        # the default, re-run the headline with the width rule applied and
+        # keep whichever number is better.  This lets the round-end bench
+        # close the tile_d/DMA lever without a builder in the loop. ---
+        # ``winning_env`` is set ONLY when the tuned re-run actually ran
+        # and beat the default end-to-end — the CLI stage must never apply
+        # a rule validated only by the w13 microbench.
+        winning_env = None
+        # the whole auto-tune block lives inside its own sub-deadline so it
+        # can never starve the operator-surface CLI stage (which needs
+        # ~RESERVE+420 s of tail); with a short window it simply skips
+        tune_deadline = time.time() + (remaining() - (RESERVE + 420))
+        if got_7b and tune_deadline - time.time() > 280 and _relay_up():
+            here = os.path.dirname(os.path.abspath(__file__))
+            probe_ms = {}
+            for tn, td in ((1024, 1024), (512, 2048), (512, 4096)):
+                left = tune_deadline - time.time()
+                if left < 80:
+                    break
+                try:
+                    r = subprocess.run(
+                        [sys.executable, os.path.join(here, "tools", "sweep_q40.py"),
+                         "--one", "classic", str(tn), str(td), "--shapes", "w13"],
+                        stdout=subprocess.PIPE, env=_child_env(), cwd=here,
+                        timeout=min(left - 10, 180))
+                    line = r.stdout.decode().strip().splitlines()[-1] if r.stdout else ""
+                    print(f"bench: tile probe ({tn},{td}): {line}", file=sys.stderr)
+                    ms = json.loads(line).get("shapes", {}).get("w13", {}).get("ms")
+                    if ms:
+                        probe_ms[(tn, td)] = float(ms)
+                except Exception as e:
+                    print(f"bench: tile probe ({tn},{td}) failed "
+                          f"({type(e).__name__})", file=sys.stderr)
+            base = probe_ms.get((1024, 1024))
+            best = min(probe_ms, key=probe_ms.get) if probe_ms else None
+            if base and best and best != (1024, 1024) \
+                    and probe_ms[best] < 0.95 * base \
+                    and tune_deadline - time.time() > 120 and chunk_out:
+                rule = json.dumps([[8192, best[0], best[1]]])
+                print(f"bench: width rule wins on w13 "
+                      f"({best}: {probe_ms[best]:.3f} ms vs {base:.3f} ms); "
+                      f"re-running headline with {rule}", file=sys.stderr)
+                tuned_out = _spawn(
+                    "llama2-7b", min(tune_deadline - time.time(), 300),
+                    env_extra={"DLLAMA_Q40_TILES_JSON": rule})
+                if tuned_out:
+                    extras["llama2-7b_default_tiles_toks"] = chunk_out["value"]
+                    if tuned_out["value"] > chunk_out["value"]:
+                        extras["tile_rule"] = rule
+                        tuned_out["metric"] += f" [width-rule tiles {rule}]"
+                        chunk_out = tuned_out
+                        winning_env = {"DLLAMA_Q40_TILES_JSON": rule}
+                    else:
+                        extras["llama2-7b_tuned_tiles_toks"] = tuned_out["value"]
         # the operator-surface run (synth .m → loader → Engine → CLI stats)
         # is the headline number when it completes (VERDICT r02 Next #3);
         # the decode_chunk number above remains the recorded cross-check.
@@ -581,7 +636,7 @@ def main():
             # the grandchild CLI process is killed at an absolute deadline
             # strictly inside the attempt timeout, so a hang can never
             # orphan it on the TPU (synthesis time is inside the deadline)
-            cli_env = {}
+            cli_env = dict(winning_env or {})  # only an end-to-end-winning rule
             cli_env["BENCH_CLI_DEADLINE"] = str(time.time() + remaining() - 240)
             cli_out = _spawn("llama2-7b-cli", remaining() - 150, env_extra=cli_env)
         # packed-MoE decode on hardware once (VERDICT r02 Next #5): the
@@ -613,25 +668,6 @@ def main():
                 extras["llama2-7b_16k_toks"] = long_out["value"]
                 print(f"bench: long-context: {json.dumps(long_out)}",
                       file=sys.stderr)
-        # tile probe: measure the tile_d/DMA-stride lever (docs/PERF.md #1)
-        # on the wide-output w13 shape so the answer lands in every driver
-        # log — one remote compile per config
-        if chunk_out and remaining() > RESERVE + 320 and _relay_up():
-            here = os.path.dirname(os.path.abspath(__file__))
-            for tn, td in ((1024, 1024), (512, 2048), (512, 4096)):
-                if remaining() < RESERVE + 60:
-                    break
-                try:
-                    r = subprocess.run(
-                        [sys.executable, os.path.join(here, "tools", "sweep_q40.py"),
-                         "--one", "classic", str(tn), str(td), "--shapes", "w13"],
-                        stdout=subprocess.PIPE, env=_child_env(), cwd=here,
-                        timeout=min(remaining() - 60, 240))
-                    line = r.stdout.decode().strip().splitlines()[-1] if r.stdout else ""
-                    print(f"bench: tile probe ({tn},{td}): {line}", file=sys.stderr)
-                except Exception as e:
-                    print(f"bench: tile probe ({tn},{td}) failed "
-                          f"({type(e).__name__})", file=sys.stderr)
         if cli_out:
             print(f"bench: decode_chunk cross-check: {json.dumps(chunk_out)}",
                   file=sys.stderr)

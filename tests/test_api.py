@@ -3,7 +3,6 @@ tiny fixture model (the reference has NO api test — SURVEY §4 gap, closed
 here)."""
 
 import json
-import socket
 import subprocess
 import sys
 import time
@@ -11,7 +10,7 @@ import urllib.request
 
 import pytest
 
-from fixtures import REPO, cpu_env, write_tiny_model, write_tiny_tokenizer
+from fixtures import REPO, cpu_env, free_port, write_tiny_model, write_tiny_tokenizer
 from dllama_tpu.server.api import ChatMessage, NaiveCache, parse_request
 
 
@@ -74,9 +73,7 @@ def server(tmp_path_factory):
     m, t = str(d / "tiny.m"), str(d / "tiny.t")
     write_tiny_model(m)
     write_tiny_tokenizer(t)
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    port = free_port()
     proc = subprocess.Popen(
         [sys.executable, "-m", "dllama_tpu.server.api", "--model", m,
          "--tokenizer", t, "--port", str(port), "--temperature", "0",
