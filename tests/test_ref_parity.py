@@ -290,6 +290,58 @@ def test_api_server_matches_reference_api_binary(tmp_path):
     assert our_out["usage"] == ref_out["usage"]
 
 
+def test_api_multiturn_conversation_matches_reference(tmp_path):
+    """Multi-turn conversation parity: a 3-message conversation (user →
+    assistant → user) rendered, prefilled and completed identically by
+    both servers.  The assistant content is plain encodable text so both
+    engines re-prefill the same token ids — generated synthetic pieces
+    would NOT round-trip decode→encode (a BPE property, not a bug: with
+    the toy vocab the reference re-encoded a turn-1 reply to 365 tokens,
+    overflowing its context into an empty reply with negative usage —
+    its api has no overflow refusal, dllama-api.cpp:284).  Our server's
+    cache-resume ≡ recompute invariant is covered by tests/test_api.py;
+    this test pins the cross-engine conversation rendering."""
+    api = _ref_api_binary()
+    mpath, tpath = str(tmp_path / "toy.m"), str(tmp_path / "toy.t")
+    _write_model(mpath, quants.F32, seq_len=256)
+    write_tiny_tokenizer(tpath, vocab_size=128)
+    convo = {"messages": [{"role": "user", "content": "hello hi"},
+                          {"role": "assistant", "content": " hello hello hi"},
+                          {"role": "user", "content": "hi hello"}],
+             "temperature": 0, "seed": 1, "max_tokens": 16}
+
+    from fixtures import cpu_env, free_port
+
+    ref_port = free_port()
+    ref = subprocess.Popen(
+        [api, "--model", mpath, "--tokenizer", tpath, "--temperature", "0",
+         "--seed", "1", "--nthreads", "1", "--buffer-float-type", "f32",
+         "--port", str(ref_port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        ref_out = _post_chat_retry(ref_port, convo, ref, 60)
+    finally:
+        ref.kill()
+
+    our_port = free_port()
+    ours = subprocess.Popen(
+        [sys.executable, "-m", "dllama_tpu.server.api", "--model", mpath,
+         "--tokenizer", tpath, "--temperature", "0", "--seed", "1",
+         "--buffer-float-type", "f32", "--chunk", "8", "--port", str(our_port)],
+        cwd=REPO, env=cpu_env(1), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT)
+    try:
+        our_out = _post_chat_retry(our_port, convo, ours)
+    finally:
+        ours.kill()
+
+    our_c = our_out["choices"][0]["message"]["content"]
+    ref_c = ref_out["choices"][0]["message"]["content"]
+    assert len(our_c) > 20, our_c
+    assert ref_c.startswith(our_c), f"ref={ref_c!r}\nours={our_c!r}"
+    assert our_out["usage"] == ref_out["usage"]
+
+
 def test_chat_turn_matches_reference_binary(tmp_path):
     """Chat-mode parity: chatml template rendering (tokenizer.cpp:447-465),
     prompt prefill across the template, streaming EOS holdback, and the
