@@ -450,6 +450,22 @@ def _emit(result, extras=None):
         signal.pthread_sigmask(signal.SIG_UNBLOCK, {signal.SIGTERM})
 
 
+_TERM_PAYLOAD = (json.dumps(
+    {"metric": "bench interrupted before a number was produced",
+     "value": 0.0, "unit": "tok/s", "vs_baseline": None}) + "\n").encode()
+
+
+def _bank_term_result(result: dict) -> None:
+    """Pre-serialize a real measurement for the SIGTERM handler: if the
+    driver's outer timeout is shorter than BENCH_BUDGET_S and kills the
+    bench mid-poll, the banked number is emitted instead of the 0.0
+    'interrupted' line."""
+    global _TERM_PAYLOAD
+    r = dict(result)
+    r.pop("backend", None)
+    _TERM_PAYLOAD = (json.dumps(r) + "\n").encode()
+
+
 def _install_term_handler():
     """If the driver tears the bench down (SIGTERM) before a number was
     emitted, still print a parseable last-resort line — a killed bench must
@@ -458,13 +474,9 @@ def _install_term_handler():
     reentrant, and the signal can land inside _emit's own print."""
     import signal
 
-    _PAYLOAD = (json.dumps(
-        {"metric": "bench interrupted before a number was produced",
-         "value": 0.0, "unit": "tok/s", "vs_baseline": None}) + "\n").encode()
-
     def _on_term(signum, frame):
         if not _EMITTED:
-            os.write(1, _PAYLOAD)
+            os.write(1, _TERM_PAYLOAD)
         os._exit(1)
 
     signal.signal(signal.SIGTERM, _on_term)
@@ -506,7 +518,18 @@ def main():
     probes_attempted = 0
     blind_probe_done = False
     waiting_logged = False
+    banked = None
+    bank_attempted = False
     while remaining() > RESERVE + 240:
+        # ~4 minutes in with no TPU yet (either degraded branch), bank the
+        # CPU fallback ONCE so a driver whose OUTER timeout is shorter than
+        # BENCH_BUDGET_S still gets a real number via the SIGTERM handler
+        # instead of the 0.0 line
+        if not bank_attempted and BUDGET_S - remaining() > 240:
+            bank_attempted = True
+            banked = _spawn("cpu-tiny", 150, env_extra=cpu_env)
+            if banked:
+                _bank_term_result(banked)
         if _relay_listening():
             probe = _spawn("probe",
                            min(PROBE_TIMEOUT_S, remaining() - RESERVE - 60))
@@ -558,6 +581,10 @@ def main():
                 break
             chunk_out = _spawn(name, min(budget, 900))
             if chunk_out:
+                # bank the hardware number immediately: a driver SIGTERM
+                # during any later stage must emit THIS, not a stale CPU
+                # line or 0.0
+                _bank_term_result(chunk_out)
                 break
         got_7b = bool(chunk_out) and "llama2-7b" in chunk_out.get("metric", "")
         # BASELINE.json north-star (Llama-3-8B, target ≥80 tok/s/chip) gets
@@ -623,6 +650,7 @@ def main():
                         extras["tile_rule"] = rule
                         tuned_out["metric"] += f" [width-rule tiles {rule}]"
                         chunk_out = tuned_out
+                        _bank_term_result(chunk_out)
                         winning_env = {"DLLAMA_Q40_TILES_JSON": rule}
                     else:
                         extras["llama2-7b_tuned_tiles_toks"] = tuned_out["value"]
@@ -639,6 +667,8 @@ def main():
             cli_env = dict(winning_env or {})  # only an end-to-end-winning rule
             cli_env["BENCH_CLI_DEADLINE"] = str(time.time() + remaining() - 240)
             cli_out = _spawn("llama2-7b-cli", remaining() - 150, env_extra=cli_env)
+            if cli_out:
+                _bank_term_result(cli_out)  # survives a kill in later stages
         # packed-MoE decode on hardware once (VERDICT r02 Next #5): the
         # QLayerView scalar-prefetch expert select must lower under Mosaic.
         # Runs after the headline stages (a hang here costs diagnostics, not
@@ -679,7 +709,8 @@ def main():
     else:
         print("bench: TPU backend unreachable — degraded CPU mode", file=sys.stderr)
 
-    out = _spawn("cpu-tiny", max(min(remaining() - 30, 420), 120), env_extra=cpu_env)
+    out = banked or _spawn("cpu-tiny", max(min(remaining() - 30, 420), 120),
+                           env_extra=cpu_env)
     if out:
         _emit(out)
         return
