@@ -29,17 +29,19 @@ def _load_lib(path: str):
     on the fast path; any failure falls back to the Python path silently."""
     if os.environ.get("DLLAMA_NO_NATIVE"):
         return None
-    # rebuild when missing OR older than any csrc source (a stale library
-    # from before a source change would silently miss symbols forever).
-    # The build is serialized with an flock and the Makefile publishes via
-    # rename, so concurrent processes (multihost tests, bench subprocesses)
-    # never dlopen a half-written ELF — and fresh libraries skip the make
-    # exec entirely.
+    # rebuild when missing OR older than anything that shapes the binary —
+    # .cpp sources, headers, and the Makefile itself (flag changes): a
+    # stale library from before a source/flag change would silently keep
+    # its old semantics forever (the hasattr symbol guard only catches
+    # *missing* entry points).  The build is serialized with an flock and
+    # the Makefile publishes via rename, so concurrent processes
+    # (multihost tests, bench subprocesses) never dlopen a half-written
+    # ELF — and fresh libraries skip the make exec entirely.
     def _stale() -> bool:
         if not os.path.exists(path):
             return True
         so_mtime = os.path.getmtime(path)
-        return any(f.endswith(".cpp") and
+        return any((f.endswith((".cpp", ".hpp", ".h")) or f == "Makefile") and
                    os.path.getmtime(os.path.join(_CSRC, f)) > so_mtime
                    for f in os.listdir(_CSRC))
 

@@ -499,21 +499,25 @@ def _tile_rules() -> list[tuple[int, int, int]]:
                   reverse=True)
 
 
-def _tiles(n: int, d: int) -> tuple[int, int]:
+def _tiles(n: int, d: int, cap_elems: int = 4 * 1024 * 1024) -> tuple[int, int]:
     """Pick reduction/output tile sizes; the ragged last D tile is masked
     on store.  Pack-time padding makes n a TILE_N multiple for whole
     tensors; a TP shard's local n may be a smaller power-of-two multiple
     (padded_n/tp), so fall down the divisor ladder rather than taking the
-    whole axis as one tile (which would blow VMEM at 7B shapes)."""
+    whole axis as one tile (which would blow VMEM at 7B shapes).
+
+    ``cap_elems`` bounds tn·td so the working set fits VMEM and is
+    codec-specific: q40's packed tile + bf16 dequant temporaries stay
+    ~12 MB at the 4 Mi default, but the q8 kernel also carries an f32
+    intermediate of tn·td·4 B (16 MB alone at 4 Mi), so its dispatch
+    passes a 2 Mi cap — one shared ladder, two ceilings (ADVICE r04 #2)."""
     for d_min, tn, td in _tile_rules():
         # tn ≥ 256 keeps the scales operand's sublane count ≥ 8 (Mosaic);
-        # td must be a positive lane-dim multiple; tn·td is capped so the
-        # working set fits VMEM for BOTH kernels sharing this ladder (q8's
-        # int8 value tile is tn·td bytes — 2× q40's packed tile — plus
-        # bf16 dequant temporaries; 4 Mi elements ≈ 12 MB worst case
-        # against ~16 MB VMEM).  Malformed rules are skipped, not applied.
+        # td must be a positive lane-dim multiple; tn·td is capped per the
+        # calling codec (see above).  Malformed rules are skipped, not
+        # applied.
         if d >= d_min and tn >= 256 and tn % 32 == 0 and n % tn == 0 \
-                and td >= 128 and td % 128 == 0 and tn * td <= 4 * 1024 * 1024:
+                and td >= 128 and td % 128 == 0 and tn * td <= cap_elems:
             return tn, td
     tile_n = n
     for tn in (TILE_N, TILE_N // 2, TILE_N // 4, TILE_N // 8, TILE_N // 16, 32):

@@ -44,6 +44,12 @@ from . import q40
 from .q40 import (PALLAS_MAX_ROWS, QLayerView, _f16_bits_to_f32, _pad_x,
                   _smap_mesh, _tiles, padded_n)
 
+# Width-rule VMEM ceiling for THIS codec: the q8 kernel carries an f32
+# accumulator intermediate of tn*td*4 B on top of the int8 value tile, so
+# a rule legal for q40 (4 Mi elements) can blow VMEM here; 2 Mi keeps the
+# worst case ~8 MB f32 + 2 MB int8 against ~16 MB VMEM (ADVICE r04 #2).
+Q8_TILE_CAP = 2 * 1024 * 1024
+
 
 @jax.tree_util.register_dataclass
 @dataclass(frozen=True)
@@ -96,8 +102,8 @@ def pack_planes_np(qvals: np.ndarray, scales: np.ndarray
 
 def quantize(w: np.ndarray) -> Q8Tensor:
     """Quantize a float array ``(..., n, d)`` along the input axis with the
-    file codec's math (delta = absmax/127, round-half-away handled by
-    np.round — quants.quantize_q80 / writer.py:58-77)."""
+    file codec's math (delta = absmax/127; round half away from zero like
+    the reference's roundf — quants.round_half_away / writer.py:58-77)."""
     w = np.asarray(w, np.float32)
     *lead, n, d = w.shape
     if n % quants.BLOCK_SIZE:
@@ -105,7 +111,8 @@ def quantize(w: np.ndarray) -> Q8Tensor:
     g = w.reshape(*lead, n // 32, 32, d)
     deltas = np.abs(g).max(axis=-2) / 127.0
     inv = np.where(deltas != 0, np.divide(1.0, deltas, where=deltas != 0), 0.0)
-    q = np.round(g * inv[..., None, :]).astype(np.int8).reshape(*lead, n, d)
+    q = quants.round_half_away(g * inv[..., None, :]) \
+        .astype(np.int8).reshape(*lead, n, d)
     with np.errstate(over="ignore"):  # overflow becomes inf → caught below
         sc = deltas.astype(np.float16)
     if not np.isfinite(sc).all():
@@ -221,7 +228,7 @@ def _pallas_matmul(x: jax.Array, qv: jax.Array, s: jax.Array,
                    tiles: tuple[int, int] | None = None) -> jax.Array:
     t, n = x.shape
     d = qv.shape[-1]
-    tile_n, tile_d = tiles or _tiles(n, d)
+    tile_n, tile_d = tiles or _tiles(n, d, cap_elems=Q8_TILE_CAP)
     grid = (pl.cdiv(d, tile_d), n // tile_n)
     return pl.pallas_call(
         functools.partial(_q8_kernel, nsteps=grid[1]),
@@ -249,7 +256,7 @@ def _pallas_matmul_stacked(x: jax.Array, qv: jax.Array, s: jax.Array,
     into the (L, n, d) HBM buffer — see q40._pallas_matmul_stacked)."""
     t, n = x.shape
     d = qv.shape[-1]
-    tile_n, tile_d = _tiles(n, d)
+    tile_n, tile_d = _tiles(n, d, cap_elems=Q8_TILE_CAP)
     grid = (pl.cdiv(d, tile_d), n // tile_n)
     return pl.pallas_call(
         functools.partial(_stacked_q8_kernel, nsteps=grid[1]),
@@ -316,7 +323,7 @@ def matmul(x: jax.Array, qt: Q8Tensor | QLayerView, impl: str = "auto",
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
         np_ = (qt.qt if is_view else qt).qpacked.shape[-2]
-        tile_n, tile_d = _tiles(np_, d)
+        tile_n, tile_d = _tiles(np_, d, cap_elems=Q8_TILE_CAP)
         impl = "pallas" if (on_tpu and rows <= PALLAS_MAX_ROWS
                             and _smap_mesh() is None
                             and _pallas_ok(tile_n, tile_d,

@@ -141,6 +141,19 @@ def q40_planes(raw: np.ndarray, shape: tuple[int, int]) -> tuple[np.ndarray, np.
 # Q80
 # ---------------------------------------------------------------------------
 
+def round_half_away(v: np.ndarray) -> np.ndarray:
+    """``roundf`` semantics — half away from zero (quants.cpp:264).
+
+    ``np.round`` is half-to-even, which differs on exact ``.5`` products,
+    so converter output could diverge byte-wise from reference-produced
+    files on those (rare) ties.  The rounding runs in float64: every f32
+    product is exact in f64 and ``v + 0.5`` cannot itself round across the
+    tie boundary there (the f32-emulation pitfall for values one ulp
+    below ``.5``)."""
+    v = np.asarray(v, np.float64)
+    return np.trunc(v + np.copysign(0.5, v))
+
+
 def quantize_q80(x: np.ndarray) -> np.ndarray:
     """Quantize a flat f32 array to Q80 bytes (writer.py:58-77 semantics)."""
     x = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
@@ -151,7 +164,7 @@ def quantize_q80(x: np.ndarray) -> np.ndarray:
     deltas = absmax / 127.0
     deltas16 = deltas.astype(np.float16)
     inv = np.where(deltas != 0, np.divide(1.0, deltas, where=deltas != 0), 0.0)
-    q = np.round(groups * inv[:, None]).astype(np.int8)
+    q = round_half_away(groups * inv[:, None]).astype(np.int8)
 
     out = np.empty((groups.shape[0], Q80_BLOCK_BYTES), dtype=np.uint8)
     out[:, :2] = deltas16.view(np.uint8).reshape(-1, 2)
