@@ -215,16 +215,25 @@ def _run_cli_bench(name, steps=320, chunk=32):
     deadline = float(os.environ.get("BENCH_CLI_DEADLINE", time.time() + 780))
     try:
         r = subprocess.run(cmd, cwd=here, stdout=subprocess.PIPE, text=True,
-                           env=_child_env(),
+                           env=_child_env({"DLLAMA_AUTO_PROFILE": "0"}),
                            timeout=max(deadline - time.time(), 60))
-    except subprocess.TimeoutExpired:
-        raise RuntimeError("CLI bench timed out (child killed)")
-    sys.stderr.write("\n".join(r.stdout.splitlines()[-8:]) + "\n")
-    if r.returncode != 0:
-        raise RuntimeError(f"CLI bench rc={r.returncode}")
-    m = re.search(r"Avg generation time:\s+([0-9.]+) ms", r.stdout)
+        out, rc = r.stdout, r.returncode
+    except subprocess.TimeoutExpired as e:
+        # the stats print before any trailing profile work — salvage them
+        # from a killed child rather than discarding a finished measurement
+        out = (e.stdout.decode() if isinstance(e.stdout, bytes)
+               else e.stdout) or ""
+        rc = None
+    sys.stderr.write("\n".join(out.splitlines()[-8:]) + "\n")
+    # rc None = deadline kill (salvage is legitimate: stats print before any
+    # trailing work); any OTHER non-zero exit means the run itself is
+    # suspect, stats line or not
+    if rc not in (0, None):
+        raise RuntimeError(f"CLI bench rc={rc}")
+    m = re.search(r"Avg generation time:\s+([0-9.]+) ms", out)
     if not m:
-        raise RuntimeError("CLI bench output had no 'Avg generation time'")
+        raise RuntimeError("CLI bench timed out (child killed)" if rc is None
+                           else "CLI bench output had no 'Avg generation time'")
     return float(m.group(1))
 
 

@@ -64,7 +64,8 @@ def _kv_chunk(s: int) -> int:
 def _online_fold(qf, kb, vb, mask, m, l, acc, scale):
     """One flash-softmax block fold shared by the blocked prefill scan and
     the length-aware decode loop: fold block scores masked by ``mask``
-    (broadcast over (B, Hkv, G)) into the running (max, denom, numerator).
+    (``(T, S)`` broadcast over (B, Hkv, G), or ``(B, T, S)`` for per-row
+    ragged-batch masks) into the running (max, denom, numerator).
 
     Dots keep the cache's dtype as operand type with f32 *accumulation*
     (bf16 in, f32 out on the MXU): widening a bf16 cache to f32 first makes
@@ -73,7 +74,9 @@ def _online_fold(qf, kb, vb, mask, m, l, acc, scale):
     achieves."""
     scores = jnp.einsum("bhgtd,bhsd->bhgts", qf.astype(kb.dtype), kb,
                         preferred_element_type=jnp.float32) * scale
-    scores = jnp.where(mask[None, None, None], scores, _NEG)
+    if mask.ndim == 2:
+        mask = mask[None]
+    scores = jnp.where(mask[:, None, None], scores, _NEG)
     m_new = jnp.maximum(m, scores.max(axis=-1))
     alpha = jnp.exp(m - m_new)
     p = jnp.exp(scores - m_new[..., None])
@@ -91,11 +94,15 @@ def _fold_init(b, hkv, g, t, dh):
 
 
 def blocked_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
-                          pos: jax.Array, q_len: int) -> jax.Array:
+                          pos: jax.Array, q_len: int,
+                          start: jax.Array | None = None) -> jax.Array:
     """Flash-style causal GQA: ``lax.scan`` over KV chunks with an online
     (running max/sum) softmax, so peak memory is O(T·chunk) instead of
     O(T·S).  Numerically equivalent to the one-shot path (same f32
     accumulation; association differs only within the rescale chain).
+
+    ``start`` (B,) masks key positions below a per-row floor — the
+    left-padding region of a ragged batch (see gqa_attention).
     """
     b, hq, t, dh = q.shape
     hkv = k_cache.shape[1]
@@ -113,7 +120,10 @@ def blocked_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 
     def body(carry, inp):
         kb, vb, base = inp
-        mask = (base + jnp.arange(c)[None, :]) <= t_idx  # (T, c)
+        s_idx = base + jnp.arange(c)[None, :]
+        mask = s_idx <= t_idx  # (T, c)
+        if start is not None:
+            mask = mask[None] & (s_idx[None] >= start[:, None, None])  # (B, T, c)
         return _online_fold(qf, kb, vb, mask, *carry, scale), None
 
     bases = jnp.arange(nc) * c
@@ -140,7 +150,7 @@ def _use_blocked_decode(t: int, s: int) -> bool:
 
 
 def blocked_live_fold(qf, slice_block, k_cache, v_cache, pos, base, c,
-                      wrap=lambda x: x):
+                      wrap=lambda x: x, row_start: jax.Array | None = None):
     """The length-aware online-softmax core: walk only the KV blocks of a
     chunk of length ``c`` (global position offset ``base``) that cover
     live positions ≤ ``pos``, folding each into the running (max, denom,
@@ -167,7 +177,10 @@ def blocked_live_fold(qf, slice_block, k_cache, v_cache, pos, base, c,
         start = i * block
         kb = slice_block(k_cache, start, block)
         vb = slice_block(v_cache, start, block)
-        mask = ((base + start + jnp.arange(block)) <= pos)[None, :]
+        s_idx = base + start + jnp.arange(block)
+        mask = (s_idx <= pos)[None, :]
+        if row_start is not None:  # ragged batch: per-row key floor
+            mask = mask[None] & (s_idx[None, None] >= row_start[:, None, None])
         m, l, acc = _online_fold(qf, kb, vb, mask, m, l, acc, scale)
         return i + 1, m, l, acc
 
@@ -179,7 +192,8 @@ def blocked_live_fold(qf, slice_block, k_cache, v_cache, pos, base, c,
 
 def decode_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                          pos: jax.Array,
-                         layer: jax.Array | None = None) -> jax.Array:
+                         layer: jax.Array | None = None,
+                         start: jax.Array | None = None) -> jax.Array:
     """Single-token causal GQA that reads only blocks covering positions
     ``0..pos``.
 
@@ -215,13 +229,14 @@ def decode_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         return blk[0]
 
     _, l, acc = blocked_live_fold(qf, slice_block, k_cache, v_cache, pos,
-                                  jnp.int32(0), s)
+                                  jnp.int32(0), s, row_start=start)
     out = acc / jnp.maximum(l, 1e-38)[..., None]
     return out.reshape(b, hq, t, dh).astype(q.dtype)
 
 
 def gqa_attention_at(q: jax.Array, ck: jax.Array, cv: jax.Array,
-                     layer: jax.Array, pos: jax.Array, q_len: int) -> jax.Array:
+                     layer: jax.Array, pos: jax.Array, q_len: int,
+                     start: jax.Array | None = None) -> jax.Array:
     """:func:`gqa_attention` over the *stacked* (L, B, Hkv, S, Dh) caches
     at ``layer``.
 
@@ -233,14 +248,15 @@ def gqa_attention_at(q: jax.Array, ck: jax.Array, cv: jax.Array,
     t = q.shape[2]
     s = ck.shape[3]
     if _use_blocked_decode(t, s):
-        return decode_gqa_attention(q, ck, cv, pos, layer=layer)
+        return decode_gqa_attention(q, ck, cv, pos, layer=layer, start=start)
     k_l = jax.lax.dynamic_index_in_dim(ck, layer, 0, keepdims=False)
     v_l = jax.lax.dynamic_index_in_dim(cv, layer, 0, keepdims=False)
-    return gqa_attention(q, k_l, v_l, pos, q_len)
+    return gqa_attention(q, k_l, v_l, pos, q_len, start=start)
 
 
 def gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
-                  pos: jax.Array, q_len: int) -> jax.Array:
+                  pos: jax.Array, q_len: int,
+                  start: jax.Array | None = None) -> jax.Array:
     """Causal GQA over the cache.
 
     q:        (B, Hq, T, Dh) — already RoPE'd
@@ -257,6 +273,15 @@ def gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     batch×kv-head) dispatch to :func:`blocked_gqa_attention`; decode over
     a long cache dispatches to the length-aware
     :func:`decode_gqa_attention`.
+
+    ``start`` (B,) is the ragged-batch key floor: row ``b`` may only see
+    key positions ``>= start[b]`` (its left-padding slots hold other
+    prompts' alignment garbage).  The mask fill is the finite ``_NEG``,
+    not -inf: a fully-masked query row (a pad position) then softmaxes to
+    uniform garbage instead of NaN — its output is never read (the head
+    picks the common last index; pad slots stay masked forever), and for
+    live rows ``exp(_NEG - m)`` underflows to exactly 0.0, so the result
+    is bit-identical to the -inf fill.
     """
     b, hq, t, dh = q.shape
     hkv = k_cache.shape[1]
@@ -264,9 +289,9 @@ def gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     g = hq // hkv
 
     if t > 1 and g * t * s > _BLOCKED_THRESHOLD:
-        return blocked_gqa_attention(q, k_cache, v_cache, pos, q_len)
+        return blocked_gqa_attention(q, k_cache, v_cache, pos, q_len, start=start)
     if _use_blocked_decode(t, s):
-        return decode_gqa_attention(q, k_cache, v_cache, pos)
+        return decode_gqa_attention(q, k_cache, v_cache, pos, start=start)
 
     # operands in cache dtype, f32 accumulation — see _online_fold for why
     qc = q.reshape(b, hkv, g, t, dh).astype(k_cache.dtype)
@@ -275,11 +300,15 @@ def gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     scores = scores / jnp.sqrt(jnp.float32(dh))
 
     # causal + validity mask: key position s_idx is visible to query t_idx
-    # iff s_idx <= pos + t_idx
+    # iff s_idx <= pos + t_idx (and, ragged, s_idx >= start[row])
     s_idx = jnp.arange(s)[None, :]
     t_idx = pos + jnp.arange(t)[:, None]
     mask = s_idx <= t_idx  # (T, S)
-    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    if start is None:
+        scores = jnp.where(mask[None, None, None], scores, _NEG)
+    else:
+        mask = mask[None] & (s_idx[None] >= start[:, None, None])  # (B, T, S)
+        scores = jnp.where(mask[:, None, None], scores, _NEG)
 
     probs = softmax_f32(scores, axis=-1)
     out = jnp.einsum("bhgts,bhsd->bhgtd", probs.astype(v_cache.dtype), v_cache,

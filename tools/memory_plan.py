@@ -63,7 +63,14 @@ def _cfg(name_or_path: str):
 
 def plan(cfg, tp=1, sp=1, dp=1, ep=1, seq_len=None, batch=1,
          kv_bytes=2, quant=True) -> dict:
-    """Per-chip byte breakdown for cfg on a tp×sp×dp×ep mesh."""
+    """Per-chip byte breakdown for cfg on a tp×sp×dp×ep mesh.
+
+    Besides residency, the plan reports ``decode_read_per_step``: the
+    weight bytes one decode step streams from HBM across the WHOLE mesh —
+    dense weights once, plus only the ``n_active_experts`` routed experts'
+    FFN bytes for MoE (non-owner ep shards read nothing: the lax.cond
+    skip in q40._sharded_matmul_ep).  Dividing by aggregate HBM bandwidth
+    gives the bandwidth-bound ms/token floor."""
     from dllama_tpu.models.params import param_shapes
 
     if cfg.n_kv_heads % tp:
@@ -78,19 +85,24 @@ def plan(cfg, tp=1, sp=1, dp=1, ep=1, seq_len=None, batch=1,
     shapes = param_shapes(cfg)
     w_sharded = 0   # matmul weights: shard 1/tp (and experts 1/ep)
     w_repl = 0      # embedding/norms/router: replicated, bf16(2B)/f32(4B)
+    decode_read = 0  # weight bytes one decode step reads, whole mesh
     for k, shp in shapes.items():
         n = 1
         for x in shp:
             n *= x
         if k in ("embedding",):
             w_repl += n * 2
+            decode_read += cfg.dim * 2  # one row gathered per token
         elif k.startswith("rms"):
             w_repl += n * 4
+            decode_read += n * 4
         elif k == "router":
             w_repl += n * 2
+            decode_read += n * 2
         else:
             per_w = Q40_BYTES_PER_WEIGHT if quant else 2
-            div = tp * (ep if k in ("up", "gate", "down") else 1)
+            is_expert = k in ("up", "gate", "down")
+            div = tp * (ep if is_expert else 1)
             if quant:
                 # packed planes pad the input axis to the kernel's block
                 # granularity (q40.padded_n; up to +9% on odd hidden dims,
@@ -103,12 +115,19 @@ def plan(cfg, tp=1, sp=1, dp=1, ep=1, seq_len=None, batch=1,
                     n *= x
                 n *= padded_n(nin) * dout
             w_sharded += n * per_w / div
+            if is_expert:
+                # only the routed experts' tiles are streamed, each read
+                # exactly once on its owner shard (the ep lax.cond skip)
+                decode_read += n * per_w * cfg.n_active_experts / cfg.n_experts
+            else:
+                decode_read += n * per_w
     cache = 2 * cfg.n_layers * batch * cfg.n_kv_heads * s * cfg.head_size * kv_bytes
     cache /= tp * sp * max(dp, 1)  # kv heads /tp, seq /sp, batch /dp
     per_chip = w_sharded + w_repl + cache + OVERHEAD
     return {
         "weights_sharded": w_sharded, "weights_replicated": w_repl,
         "kv_cache": cache, "overhead": OVERHEAD, "per_chip": per_chip,
+        "decode_read_per_step": decode_read,
         "fits_v5e": per_chip <= V5E_HBM,
     }
 
