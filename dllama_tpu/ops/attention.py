@@ -21,6 +21,29 @@ import numpy as np
 from .kernels import softmax_f32
 
 
+def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-(…, position) int8 quantization of a KV step window
+    (B, Hkv, T, Dh) → int8 values + f32 absmax/127 scales (B, Hkv, T, 1).
+
+    The int8 KV cache (beyond reference — transformer.cpp:280-282 holds
+    f32) halves cache HBM traffic and residency vs bf16; a per-position
+    scale over Dh values keeps the quantization row-local so decode's
+    block reads stay self-contained."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = amax / 127.0
+    inv = jnp.where(scale > 0, 1.0 / jnp.where(scale > 0, scale, 1.0), 0.0)
+    q = jnp.round(xf * inv).astype(jnp.int8)
+    return q, scale
+
+
+def dequant_kv(vals: jax.Array, scale: jax.Array) -> jax.Array:
+    """int8 KV block × f32 per-position scale → bf16 (the dot operand
+    dtype _online_fold wants: the cast+mul fuses into the score dot, so
+    only int8 bytes cross HBM)."""
+    return (vals.astype(jnp.float32) * scale).astype(jnp.bfloat16)
+
+
 def update_kv_cache_at(k_cache: jax.Array, v_cache: jax.Array,
                        k_new: jax.Array, v_new: jax.Array,
                        layer: jax.Array, pos: jax.Array
@@ -193,7 +216,9 @@ def blocked_live_fold(qf, slice_block, k_cache, v_cache, pos, base, c,
 def decode_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                          pos: jax.Array,
                          layer: jax.Array | None = None,
-                         start: jax.Array | None = None) -> jax.Array:
+                         start: jax.Array | None = None,
+                         scales: tuple[jax.Array, jax.Array] | None = None
+                         ) -> jax.Array:
     """Single-token causal GQA that reads only blocks covering positions
     ``0..pos``.
 
@@ -220,15 +245,32 @@ def decode_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     qf = q.astype(jnp.float32).reshape(b, hkv, g, t, dh)
 
     def slice_block(cache, start, length):
+        # last dim from the array itself: serves both (…, Dh) value blocks
+        # and (…, 1) scale columns with one index recipe
         if layer is None:
             return jax.lax.dynamic_slice_in_dim(cache, start, length, axis=2)
         zero = jnp.zeros((), jnp.int32)
         blk = jax.lax.dynamic_slice(
             cache, (layer.astype(jnp.int32), zero, zero, start, zero),
-            (1, b, hkv, length, dh))
+            (1, b, hkv, length, cache.shape[-1]))
         return blk[0]
 
-    _, l, acc = blocked_live_fold(qf, slice_block, k_cache, v_cache, pos,
+    if scales is None:
+        kc_arg, vc_arg = k_cache, v_cache
+        sl = slice_block
+    else:
+        # int8 cache: slice the value block AND its per-position scale
+        # column, dequantize after the (int8-sized) HBM read
+        ks, vs = scales
+
+        def sl(pair, start, length):
+            vals, sc = pair
+            return dequant_kv(slice_block(vals, start, length),
+                              slice_block(sc, start, length))
+
+        kc_arg, vc_arg = (k_cache, ks), (v_cache, vs)
+
+    _, l, acc = blocked_live_fold(qf, sl, kc_arg, vc_arg, pos,
                                   jnp.int32(0), s, row_start=start)
     out = acc / jnp.maximum(l, 1e-38)[..., None]
     return out.reshape(b, hq, t, dh).astype(q.dtype)
@@ -236,7 +278,9 @@ def decode_gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 
 def gqa_attention_at(q: jax.Array, ck: jax.Array, cv: jax.Array,
                      layer: jax.Array, pos: jax.Array, q_len: int,
-                     start: jax.Array | None = None) -> jax.Array:
+                     start: jax.Array | None = None,
+                     scales: tuple[jax.Array, jax.Array] | None = None
+                     ) -> jax.Array:
     """:func:`gqa_attention` over the *stacked* (L, B, Hkv, S, Dh) caches
     at ``layer``.
 
@@ -244,13 +288,26 @@ def gqa_attention_at(q: jax.Array, ck: jax.Array, cv: jax.Array,
     stacked buffer (O(pos) traffic end to end); the short-cache and
     prefill paths read the layer slice, which XLA fuses into the score
     dot rather than materializing (observed in the 7B decode xplane).
+
+    ``scales``: the int8-cache dequant planes (Lk, Lv stacked,
+    (L, B, Hkv, S, 1) f32).  The decode path dequantizes block-wise (the
+    HBM read stays int8-sized — the point of the quantized cache); the
+    short/prefill paths dequantize the layer slice, which XLA fuses into
+    the dot like the plain cast.
     """
     t = q.shape[2]
     s = ck.shape[3]
     if _use_blocked_decode(t, s):
-        return decode_gqa_attention(q, ck, cv, pos, layer=layer, start=start)
+        return decode_gqa_attention(q, ck, cv, pos, layer=layer, start=start,
+                                    scales=scales)
     k_l = jax.lax.dynamic_index_in_dim(ck, layer, 0, keepdims=False)
     v_l = jax.lax.dynamic_index_in_dim(cv, layer, 0, keepdims=False)
+    if scales is not None:
+        ks, vs = scales
+        k_l = dequant_kv(k_l, jax.lax.dynamic_index_in_dim(ks, layer, 0,
+                                                           keepdims=False))
+        v_l = dequant_kv(v_l, jax.lax.dynamic_index_in_dim(vs, layer, 0,
+                                                           keepdims=False))
     return gqa_attention(q, k_l, v_l, pos, q_len, start=start)
 
 
