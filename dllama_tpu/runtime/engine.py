@@ -471,34 +471,53 @@ class Engine:
         per-row bookkeeping an incremental server needs lives in
         server/api.py.
         """
-        from .decode_loop import device_sample
         if steps <= 0:
             raise ValueError("steps must be positive")
-        if seed is not None:
-            self._key = jax.random.PRNGKey(seed)
-            self._chunk_counter = 0
-        steps = min(steps, self.seq_len)
-
-        logits, _ = self.prefill_ragged(prompts)  # validates batch/sp/pos
+        steps = min(steps, self.seq_len)  # same clamp as the stream core
         outs = [list(p) for p in prompts]
         done = [len(o) >= steps for o in outs]
-
-        def absorb(row_tokens: np.ndarray) -> np.ndarray | None:
+        for row_tokens in self.generate_batch_stream(
+                prompts, steps, temperature=temperature, topp=topp,
+                seed=seed, chunk=chunk):
             for r, t in enumerate(row_tokens.tolist()):
                 if done[r]:
                     continue
                 outs[r].append(int(t))
                 if int(t) in eos_ids or len(outs[r]) >= steps:
                     done[r] = True
-            return None
+            if all(done):
+                break
+        return outs
 
+    def generate_batch_stream(self, prompts: list[list[int]], steps: int, *,
+                              temperature: float = 0.0, topp: float = 0.9,
+                              seed: int | None = 0, chunk: int = 16):
+        """The lockstep core of :meth:`generate_batch`, as a generator:
+        yields one ``(B,)`` int32 array per decoded step, every row's
+        sampled token, as each on-device chunk lands.  EOS/length policy
+        belongs to the consumer (generate_batch truncates per row; the
+        API server streams per-row deltas with its own stop detectors) —
+        finished rows keep decoding in lockstep and their later tokens
+        are simply ignored.  The stream ends at the context window;
+        consumers that want fewer tokens must stop iterating (both
+        built-in consumers break when every row is done).  Abandoning the
+        generator mid-batch is fine: the batch is one-shot, not a
+        continuable conversation."""
+        from .decode_loop import device_sample
+        if steps <= 0:
+            raise ValueError("steps must be positive")
+        if seed is not None:
+            self._key = jax.random.PRNGKey(seed)
+            self._chunk_counter = 0
+
+        logits, _ = self.prefill_ragged(prompts)  # validates batch/sp/pos
         sub = jax.random.fold_in(self._key, self._chunk_counter)
         self._chunk_counter += 1
         tok_vec = np.asarray(device_sample(
             jnp.asarray(logits), sub, temperature, topp))  # (B,)
-        absorb(tok_vec)
+        yield tok_vec
 
-        while not all(done) and self.pos < self.seq_len:
+        while self.pos < self.seq_len:
             k = min(chunk, self.seq_len - self.pos)
             fn = self._chunk_fn(k, temperature, topp)
             sub = jax.random.fold_in(self._key, self._chunk_counter)
@@ -511,11 +530,8 @@ class Engine:
             toks = np.asarray(toks_dev)  # (k, B)
             self.pos += k
             for j in range(toks.shape[0]):
-                absorb(toks[j])
-                if all(done):
-                    break
+                yield toks[j]
             tok_vec = toks[-1]
-        return outs
 
     def generate(self, prompt_tokens: list[int], steps: int, sampler: Sampler,
                  eos_ids: tuple[int, ...] = (), prefill_single_token: bool = False):

@@ -36,7 +36,7 @@ from ..runtime.engine import ContextOverflow, Engine
 from ..runtime.stream import drain_generation
 from ..tokenizer.bpe import Tokenizer
 from ..tokenizer.chat import ChatItem, ChatTemplate, TokenizerChatStops
-from ..tokenizer.eos import EosDetector
+from ..tokenizer.eos import EOS, MAYBE_EOS, EosDetector
 
 
 @dataclass
@@ -186,19 +186,13 @@ class ApiState:
         return reply, len(prompt_tokens), n_completion
 
     # ------------------------------------------------------------------
-    def complete_batch(self, prompts: list[str], *, temperature: float,
-                       top_p: float, max_tokens: int, seed: int | None,
-                       stop: list[str], echo: bool = False
-                       ) -> tuple[list[dict], int, int]:
-        """Run B distinct prompts as one lockstep batch on ``batch_engine``.
-
-        Returns (choices, prompt_tokens, completion_tokens).  Prompt lists
-        shorter than the engine's batch are padded by repeating the first
-        prompt (pad rows' outputs are dropped); longer lists are the
-        caller's 400.  ``stop`` strings truncate post-hoc — batch mode is
-        offline-style serving, not token streaming, so the EosDetector's
-        incremental hold-back buys nothing here.
-        """
+    def plan_batch(self, prompts: list[str], max_tokens: int
+                   ) -> tuple[list[list[int]], int, int, int]:
+        """Validate + tokenize a /v1/completions batch; the single copy of
+        the slot/padding/budget recipe shared by the streaming and
+        non-streaming paths.  Returns (id_lists, n_real, budget, eos_id);
+        raises ContextOverflow for every client-side problem so handlers
+        can 400 BEFORE committing to a response kind."""
         eng, tok = self.batch_engine, self.tokenizer
         if eng is None:
             raise ValueError("batched serving not enabled (--batch-slots)")
@@ -216,10 +210,27 @@ class ApiState:
         budget = eng.seq_len
         if max_tokens > 0:
             budget = min(max(len(i) for i in id_lists) + max_tokens, eng.seq_len)
-        eng.reset()
         # plain-text completion stops at the base EOS (generate-mode
         # semantics), not the chat template's stop token
         eos_id = tok.eos_id if tok.eos_id >= 0 else tok.chat_eos_id
+        return id_lists, n_real, budget, eos_id
+
+    def complete_batch(self, prompts: list[str], *, temperature: float,
+                       top_p: float, max_tokens: int, seed: int | None,
+                       stop: list[str], echo: bool = False
+                       ) -> tuple[list[dict], int, int]:
+        """Run B distinct prompts as one lockstep batch on ``batch_engine``.
+
+        Returns (choices, prompt_tokens, completion_tokens).  Prompt lists
+        shorter than the engine's batch are padded by repeating the first
+        prompt (pad rows' outputs are dropped); longer lists are the
+        caller's 400.  ``stop`` strings truncate post-hoc — batch mode is
+        offline-style serving, not token streaming, so the EosDetector's
+        incremental hold-back buys nothing here.
+        """
+        eng, tok = self.batch_engine, self.tokenizer
+        id_lists, n_real, budget, eos_id = self.plan_batch(prompts, max_tokens)
+        eng.reset()
         outs = eng.generate_batch(
             id_lists, budget, temperature=temperature, topp=top_p,
             seed=seed if seed is not None else int(time.time()),
@@ -251,6 +262,94 @@ class ApiState:
             choices.append({"text": text, "index": r,
                             "finish_reason": finish, "logprobs": None})
         return choices, n_prompt, n_completion
+
+    # ------------------------------------------------------------------
+    def complete_batch_stream(self, prompts: list[str], *, temperature: float,
+                              top_p: float, max_tokens: int, seed: int | None,
+                              stop: list[str], emit,
+                              plan: tuple | None = None) -> None:
+        """Streaming complement of :meth:`complete_batch`: drives the same
+        lockstep batch but calls ``emit(row_index, delta_text,
+        finish_reason_or_None)`` as each row's text becomes safe to send.
+        A row that finishes stops emitting while the batch keeps decoding
+        for the rows still live.
+
+        Parity details that keep stream ≡ non-stream for the same seed:
+        per-row *incremental* UTF-8 decoding (a codepoint split across
+        byte-fallback tokens reassembles instead of becoming U+FFFD —
+        whole-sequence decode gets this for free), and stop strings
+        checked against the row's accumulated not-yet-sent text (the
+        EosDetector's boundary window alone misses a stop buried deep
+        inside one BPE piece).  ``plan`` lets the HTTP handler run
+        :meth:`plan_batch` (and 400) before committing to SSE headers.
+        """
+        import codecs
+        eng, tok = self.batch_engine, self.tokenizer
+        id_lists, n_real, budget, eos_id = \
+            plan if plan is not None else self.plan_batch(prompts, max_tokens)
+        eng.reset()
+        detectors = [EosDetector(eos_id, stop, padding_left=2, padding_right=2)
+                     for _ in range(n_real)]
+        decoders = [codecs.getincrementaldecoder("utf-8")("replace")
+                    for _ in range(n_real)]
+        prev = [ids[-1] for ids in id_lists[:n_real]]
+        n_comp = [0] * n_real
+        cap = [max_tokens if max_tokens > 0
+               else eng.seq_len - len(id_lists[r]) for r in range(n_real)]
+        done = [False] * n_real
+
+        def send(r, delta, finish):
+            """Emit ``delta`` unless a stop string completes inside it —
+            the post-hoc `text.find` semantics of complete_batch, applied
+            to the unsent tail (sent text cannot be retracted; the
+            detector's hold-back keeps boundary-spanning stops unsent)."""
+            if delta:
+                for s in stop:
+                    cut = delta.find(s)
+                    if cut != -1:
+                        emit(r, delta[:cut], "stop")
+                        done[r] = True
+                        return
+            if finish:
+                done[r] = True
+            if delta or finish:
+                emit(r, delta, finish)
+
+        def tail(r):
+            """A finishing row's last text: any held-back partial-stop
+            characters PLUS the incremental decoder's final flush (a
+            codepoint left dangling mid-sequence becomes U+FFFD, exactly
+            as the non-streaming whole-sequence decode renders it)."""
+            return (detectors[r].get_delta() or "") + decoders[r].decode(b"", True)
+
+        for step_vec in eng.generate_batch_stream(
+                id_lists, budget, temperature=temperature, topp=top_p,
+                seed=seed if seed is not None else int(time.time()),
+                chunk=self.chunk):
+            for r in range(n_real):
+                if done[r]:
+                    continue
+                t = int(step_vec[r])
+                n_comp[r] += 1
+                piece = decoders[r].decode(tok.decode_piece(prev[r], t))
+                prev[r] = t
+                res = detectors[r].append(t, piece)
+                if res != MAYBE_EOS:
+                    delta = detectors[r].get_delta()
+                    detectors[r].clear()
+                    if res == EOS:
+                        send(r, (delta or "") + decoders[r].decode(b"", True),
+                             "stop")
+                        continue
+                    if delta:
+                        send(r, delta, None)
+                if not done[r] and n_comp[r] >= cap[r]:
+                    send(r, tail(r), "length")
+            if all(done):
+                break
+        for r in range(n_real):
+            if not done[r]:  # budget exhausted mid-hold-back
+                send(r, tail(r), "length")
 
 
 def make_handler(state: ApiState):
@@ -294,12 +393,53 @@ def make_handler(state: ApiState):
                 stop = [stop] if isinstance(stop, str) else \
                     [str(s) for s in stop] if isinstance(stop, list) else []
                 echo = bool(body.get("echo"))
+                stream = bool(body.get("stream"))
             except (TypeError, ValueError, json.JSONDecodeError) as e:
                 self._json(400, {"error": f"bad request: {e}"})
                 return
             if state.batch_engine is None:
                 self._json(400, {"error": "batched serving not enabled; "
                                           "start the server with --batch-slots N"})
+                return
+            created = int(time.time())
+            cid = f"cmpl-{uuid.uuid4().hex[:12]}"
+            if stream:
+                # validate BEFORE committing to SSE: an invalid request
+                # gets the same 400 it would get without stream=true
+                try:
+                    plan = state.plan_batch(prompts, max_tokens)
+                except ContextOverflow as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                # SSE chunks carry per-row deltas tagged by choice index —
+                # every live row streams concurrently from the one
+                # lockstep batch (echo is a non-streaming nicety; ignored)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+
+                def emit(idx, delta, finish):
+                    chunk = {"id": cid, "object": "text_completion",
+                             "created": created, "model": state.model_name,
+                             "choices": [{"text": delta, "index": idx,
+                                          "finish_reason": finish,
+                                          "logprobs": None}]}
+                    self.wfile.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                    self.wfile.flush()
+
+                try:
+                    # the [DONE] sentinel goes out even if the engine dies
+                    # mid-stream (clients block on it); the exception still
+                    # propagates to the 500 path afterwards
+                    state.complete_batch_stream(
+                        prompts, temperature=temperature, top_p=top_p,
+                        max_tokens=max_tokens, seed=seed, stop=stop,
+                        emit=emit, plan=plan)
+                finally:
+                    self.wfile.write(b"data: [DONE]\n\n")
+                    self.wfile.flush()
                 return
             try:
                 choices, n_prompt, n_completion = state.complete_batch(
@@ -309,8 +449,8 @@ def make_handler(state: ApiState):
                 self._json(400, {"error": str(e)})
                 return
             self._json(200, {
-                "id": f"cmpl-{uuid.uuid4().hex[:12]}",
-                "object": "text_completion", "created": int(time.time()),
+                "id": cid,
+                "object": "text_completion", "created": created,
                 "model": state.model_name, "choices": choices,
                 "usage": {"prompt_tokens": n_prompt,
                           "completion_tokens": n_completion,

@@ -213,6 +213,54 @@ def test_completions_over_slots_is_400(server):
     assert e.value.code == 400
 
 
+def test_completions_streaming_matches_non_stream(server):
+    """SSE completions stream per-row deltas tagged by choice index from
+    the one lockstep batch; reassembled text must equal the
+    non-streaming response for the same request."""
+    base = {"prompt": ["the sky", "one two three"], "max_tokens": 6,
+            "temperature": 0, "seed": 1}
+    with post(server, "/v1/completions", base) as r:
+        plain = json.loads(r.read())
+    with post(server, "/v1/completions", {**base, "stream": True}) as r:
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        raw = r.read().decode()
+    events = [l[6:] for l in raw.splitlines() if l.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    texts, finishes = {0: "", 1: ""}, {}
+    for e in events[:-1]:
+        c = json.loads(e)["choices"][0]
+        texts[c["index"]] += c["text"]
+        if c["finish_reason"]:
+            finishes[c["index"]] = c["finish_reason"]
+    for i, choice in enumerate(plain["choices"]):
+        assert texts[i] == choice["text"], (i, texts, plain)
+        assert finishes[i] == choice["finish_reason"]
+
+
+def test_completions_stop_string_stream_parity(server):
+    """A stop string buried inside the generated text must truncate the
+    stream exactly where the non-streaming post-hoc find() truncates."""
+    base = {"prompt": "the sky", "max_tokens": 10, "temperature": 0, "seed": 1}
+    with post(server, "/v1/completions", base) as r:
+        full = json.loads(r.read())["choices"][0]["text"]
+    if len(full) < 4:
+        pytest.skip("fixture generated too little text to cut")
+    stop = full[len(full) // 2:len(full) // 2 + 2]
+    body = {**base, "stop": [stop]}
+    with post(server, "/v1/completions", body) as r:
+        plain = json.loads(r.read())["choices"][0]
+    with post(server, "/v1/completions", {**body, "stream": True}) as r:
+        raw = r.read().decode()
+    text, finish = "", None
+    for e in [l[6:] for l in raw.splitlines() if l.startswith("data: ")][:-1]:
+        c = json.loads(e)["choices"][0]
+        text += c["text"]
+        finish = c["finish_reason"] or finish
+    assert stop not in text
+    assert text == plain["text"]
+    assert finish == plain["finish_reason"] == "stop"
+
+
 def test_concurrent_requests_serialize(server):
     """Two clients at once: the accept queue serializes them; both must get
     complete, independent answers (documented queue semantics)."""

@@ -170,7 +170,10 @@ def main():
     ap.add_argument("--dp", type=int, default=1)
     ap.add_argument("--ep", type=int, default=1)
     ap.add_argument("--batch", type=int, default=1)
-    ap.add_argument("--kv-dtype-bytes", type=int, default=2)
+    ap.add_argument("--kv-dtype-bytes", type=float, default=2,
+                    help="bytes per cache element: 4 f32, 2 bf16 (default), "
+                         "1.03 for the int8 cache (--kv-cache-dtype q8: "
+                         "1 B values + 4 B/Dh scales)")
     ap.add_argument("--dense", action="store_true",
                     help="dense bf16 weights instead of packed Q40")
     ap.add_argument("--fit", action="store_true",
