@@ -36,7 +36,20 @@ from ..runtime.engine import ContextOverflow, Engine
 from ..runtime.stream import drain_generation
 from ..tokenizer.bpe import Tokenizer
 from ..tokenizer.chat import ChatItem, ChatTemplate, TokenizerChatStops
-from ..tokenizer.eos import EOS, MAYBE_EOS, EosDetector
+from ..tokenizer.eos import EosDetector
+
+
+def _decode_continuation(tok: Tokenizer, prev: int, token_ids: list[int]) -> str:
+    """Decode a continuation with ``prev`` = the last prompt token — NOT
+    from BOS: sentencepiece-style decode-from-BOS strips the first piece's
+    leading space (bpe.py decode_piece), which is wrong for text that
+    continues a prompt and diverges from the incremental/streaming
+    decoders.  One copy shared by every non-streaming batch path."""
+    parts = []
+    for t in token_ids:
+        parts.append(tok.decode_piece(prev, t))
+        prev = t
+    return b"".join(parts).decode("utf-8", errors="replace")
 
 
 @dataclass
@@ -88,6 +101,7 @@ class InferenceParams:
     stream: bool = False
     seed: int | None = None
     stop: list[str] = field(default_factory=list)
+    n: int = 1  # choices per request; n>1 runs on the batch engine
 
 
 def parse_request(body: dict, default_temp: float, default_topp: float) -> InferenceParams:
@@ -106,6 +120,8 @@ def parse_request(body: dict, default_temp: float, default_topp: float) -> Infer
         p.stream = bool(body["stream"])
     if body.get("seed") is not None:
         p.seed = int(body["seed"])
+    if body.get("n") is not None:
+        p.n = int(body["n"])
     stop = body.get("stop")
     if isinstance(stop, str):
         p.stop = [stop]
@@ -186,34 +202,89 @@ class ApiState:
         return reply, len(prompt_tokens), n_completion
 
     # ------------------------------------------------------------------
-    def plan_batch(self, prompts: list[str], max_tokens: int
-                   ) -> tuple[list[list[int]], int, int, int]:
-        """Validate + tokenize a /v1/completions batch; the single copy of
-        the slot/padding/budget recipe shared by the streaming and
-        non-streaming paths.  Returns (id_lists, n_real, budget, eos_id);
-        raises ContextOverflow for every client-side problem so handlers
+    def _plan_ids(self, id_lists: list[list[int]], max_tokens: int,
+                  eos_id: int) -> tuple[list[list[int]], int, int, int]:
+        """THE batched-serving validation/padding/budget recipe — single
+        copy shared by /v1/completions (stream and not) and chat ``n>1``.
+        Pads the real rows to the engine's batch by repeating row 0 and
+        raises ContextOverflow for every client-side problem, so handlers
         can 400 BEFORE committing to a response kind."""
-        eng, tok = self.batch_engine, self.tokenizer
+        eng = self.batch_engine
         if eng is None:
             raise ValueError("batched serving not enabled (--batch-slots)")
-        n_real = len(prompts)
+        n_real = len(id_lists)
         if not (0 < n_real <= eng.batch):
             raise ContextOverflow(
                 f"{n_real} prompts for {eng.batch} batch slots")
-        padded = prompts + [prompts[0]] * (eng.batch - n_real)
-        id_lists = [tok.encode(p, add_bos=eng.cfg.add_bos) for p in padded]
         if any(not ids for ids in id_lists):
             # a BOS-less tokenizer can encode "" to zero tokens; surface it
             # as the client-error type rather than letting the engine's
             # ValueError kill the connection with no HTTP response
             raise ContextOverflow("a prompt encoded to zero tokens")
+        longest = max(len(i) for i in id_lists)
+        if longest + 1 >= eng.seq_len:
+            raise ContextOverflow(
+                f"prompt needs {longest} of {eng.seq_len} context positions")
+        padded = [list(i) for i in id_lists] \
+            + [list(id_lists[0])] * (eng.batch - n_real)
         budget = eng.seq_len
         if max_tokens > 0:
-            budget = min(max(len(i) for i in id_lists) + max_tokens, eng.seq_len)
+            budget = min(longest + max_tokens, eng.seq_len)
+        return padded, n_real, budget, eos_id
+
+    def complete_n(self, params: InferenceParams
+                   ) -> tuple[list[str], int, int]:
+        """``n > 1`` chat choices: the templated prompt replicated n times
+        decodes as one lockstep batch on ``batch_engine`` — n *sampled*
+        alternatives per weight read (greedy rows are identical, as with
+        any sampler).  Fresh conversation each time: the batch engine has
+        its own cache and the NaiveCache is neither consulted nor updated
+        (n distinct replies cannot extend one conversation prefix)."""
+        eng, tok = self.batch_engine, self.tokenizer
+        items = [ChatItem(m.role, m.content) for m in params.messages]
+        text = self.template.generate(items, True)
+        prompt_tokens = tok.encode(text, add_bos=True)
+        id_lists, _, budget, eos_id = self._plan_ids(
+            [prompt_tokens] * params.n, params.max_tokens, tok.chat_eos_id)
+        eng.reset()
+        outs = eng.generate_batch(
+            id_lists, budget, temperature=params.temperature,
+            topp=params.top_p,
+            seed=params.seed if params.seed is not None else int(time.time()),
+            eos_ids=(eos_id,), chunk=self.chunk)
+        replies = []
+        n_completion = 0
+        for r in range(params.n):
+            comp = outs[r][len(prompt_tokens):]
+            if comp and comp[-1] == eos_id:
+                comp = comp[:-1]
+            n_completion += len(comp)
+            # continuation decode (prev = last prompt token), NOT
+            # tok.decode: decode-from-BOS strips a leading space, which the
+            # n=1 path's incremental drain keeps — the n choices must read
+            # exactly like the single-choice reply
+            reply = _decode_continuation(tok, prompt_tokens[-1], comp)
+            for s in self.base_stops + params.stop:
+                cut = reply.find(s)
+                if cut != -1:
+                    reply = reply[:cut]
+            replies.append(reply)
+        return replies, len(prompt_tokens), n_completion
+
+    # ------------------------------------------------------------------
+    def plan_batch(self, prompts: list[str], max_tokens: int
+                   ) -> tuple[list[list[int]], int, int, int]:
+        """Tokenize a /v1/completions prompt list and run it through
+        :meth:`_plan_ids` (the shared validation/budget recipe)."""
+        tok = self.tokenizer
+        if self.batch_engine is None:
+            raise ValueError("batched serving not enabled (--batch-slots)")
+        id_lists = [tok.encode(p, add_bos=self.batch_engine.cfg.add_bos)
+                    for p in prompts]
         # plain-text completion stops at the base EOS (generate-mode
         # semantics), not the chat template's stop token
         eos_id = tok.eos_id if tok.eos_id >= 0 else tok.chat_eos_id
-        return id_lists, n_real, budget, eos_id
+        return self._plan_ids(id_lists, max_tokens, eos_id)
 
     def complete_batch(self, prompts: list[str], *, temperature: float,
                        top_p: float, max_tokens: int, seed: int | None,
@@ -252,8 +323,11 @@ class ApiState:
                 finish = "stop"
             n_prompt += len(ids)
             n_completion += len(comp)
-            text = tok.decode((ids + comp) if echo else comp) \
-                if (comp or echo) else ""
+            # continuation decode (see _decode_continuation) — echo
+            # prepends the prompt's own from-BOS decode
+            text = _decode_continuation(tok, ids[-1], comp)
+            if echo:
+                text = tok.decode(ids) + text
             for s in stop:
                 cut = text.find(s)
                 if cut != -1:
@@ -276,51 +350,51 @@ class ApiState:
 
         Parity details that keep stream ≡ non-stream for the same seed:
         per-row *incremental* UTF-8 decoding (a codepoint split across
-        byte-fallback tokens reassembles instead of becoming U+FFFD —
-        whole-sequence decode gets this for free), and stop strings
-        checked against the row's accumulated not-yet-sent text (the
-        EosDetector's boundary window alone misses a stop buried deep
-        inside one BPE piece).  ``plan`` lets the HTTP handler run
-        :meth:`plan_batch` (and 400) before committing to SSE headers.
+        byte-fallback tokens reassembles instead of becoming U+FFFD, with
+        a final flush when the row closes), and a per-row hold-back
+        buffer of ``max(len(stop))-1`` characters — a stop string can
+        begin anywhere inside a BPE piece and span any number of pieces,
+        so the buffer scan sees exactly what complete_batch's post-hoc
+        ``text.find`` sees, and no prefix of a stop is ever emitted
+        early.  ``plan`` lets the HTTP handler run :meth:`plan_batch`
+        (and 400) before committing to SSE headers.
         """
         import codecs
         eng, tok = self.batch_engine, self.tokenizer
         id_lists, n_real, budget, eos_id = \
             plan if plan is not None else self.plan_batch(prompts, max_tokens)
         eng.reset()
-        detectors = [EosDetector(eos_id, stop, padding_left=2, padding_right=2)
-                     for _ in range(n_real)]
         decoders = [codecs.getincrementaldecoder("utf-8")("replace")
                     for _ in range(n_real)]
+        hold = max((len(s) for s in stop), default=0)
         prev = [ids[-1] for ids in id_lists[:n_real]]
+        buf = [""] * n_real   # decoded but not yet emitted
         n_comp = [0] * n_real
         cap = [max_tokens if max_tokens > 0
                else eng.seq_len - len(id_lists[r]) for r in range(n_real)]
         done = [False] * n_real
 
-        def send(r, delta, finish):
-            """Emit ``delta`` unless a stop string completes inside it —
-            the post-hoc `text.find` semantics of complete_batch, applied
-            to the unsent tail (sent text cannot be retracted; the
-            detector's hold-back keeps boundary-spanning stops unsent)."""
-            if delta:
-                for s in stop:
-                    cut = delta.find(s)
-                    if cut != -1:
-                        emit(r, delta[:cut], "stop")
-                        done[r] = True
-                        return
-            if finish:
+        def flush(r, closing):
+            """Scan the row's unsent buffer for stops; emit everything
+            safe.  While the row is live, the last ``hold-1`` characters
+            stay buffered (a stop could still complete across the
+            boundary); on close the whole buffer goes out."""
+            cuts = [c for c in (buf[r].find(s) for s in stop) if c != -1]
+            if cuts:
+                emit(r, buf[r][:min(cuts)], "stop")
+                buf[r] = ""
                 done[r] = True
-            if delta or finish:
-                emit(r, delta, finish)
-
-        def tail(r):
-            """A finishing row's last text: any held-back partial-stop
-            characters PLUS the incremental decoder's final flush (a
-            codepoint left dangling mid-sequence becomes U+FFFD, exactly
-            as the non-streaming whole-sequence decode renders it)."""
-            return (detectors[r].get_delta() or "") + decoders[r].decode(b"", True)
+                return
+            if closing:
+                done[r] = True
+                emit(r, buf[r], "length")
+                buf[r] = ""
+            elif hold and len(buf[r]) >= hold:
+                emit(r, buf[r][:len(buf[r]) - (hold - 1)], None)
+                buf[r] = buf[r][len(buf[r]) - (hold - 1):]
+            elif not hold and buf[r]:
+                emit(r, buf[r], None)
+                buf[r] = ""
 
         for step_vec in eng.generate_batch_stream(
                 id_lists, budget, temperature=temperature, topp=top_p,
@@ -331,25 +405,29 @@ class ApiState:
                     continue
                 t = int(step_vec[r])
                 n_comp[r] += 1
-                piece = decoders[r].decode(tok.decode_piece(prev[r], t))
+                if t == eos_id:
+                    # eos text never enters the reply; flush and close as
+                    # "stop" unless a stop string fires in the buffer
+                    buf[r] += decoders[r].decode(b"", True)
+                    cuts = [c for c in (buf[r].find(s) for s in stop)
+                            if c != -1]
+                    emit(r, buf[r][:min(cuts)] if cuts else buf[r], "stop")
+                    buf[r] = ""
+                    done[r] = True
+                    continue
+                buf[r] += decoders[r].decode(tok.decode_piece(prev[r], t))
                 prev[r] = t
-                res = detectors[r].append(t, piece)
-                if res != MAYBE_EOS:
-                    delta = detectors[r].get_delta()
-                    detectors[r].clear()
-                    if res == EOS:
-                        send(r, (delta or "") + decoders[r].decode(b"", True),
-                             "stop")
-                        continue
-                    if delta:
-                        send(r, delta, None)
-                if not done[r] and n_comp[r] >= cap[r]:
-                    send(r, tail(r), "length")
+                if n_comp[r] >= cap[r]:
+                    buf[r] += decoders[r].decode(b"", True)
+                    flush(r, closing=True)
+                else:
+                    flush(r, closing=False)
             if all(done):
                 break
         for r in range(n_real):
-            if not done[r]:  # budget exhausted mid-hold-back
-                send(r, tail(r), "length")
+            if not done[r]:  # budget exhausted with text still buffered
+                buf[r] += decoders[r].decode(b"", True)
+                flush(r, closing=True)
 
 
 def make_handler(state: ApiState):
@@ -430,16 +508,27 @@ def make_handler(state: ApiState):
                     self.wfile.flush()
 
                 try:
-                    # the [DONE] sentinel goes out even if the engine dies
-                    # mid-stream (clients block on it); the exception still
-                    # propagates to the 500 path afterwards
                     state.complete_batch_stream(
                         prompts, temperature=temperature, top_p=top_p,
                         max_tokens=max_tokens, seed=seed, stop=stop,
                         emit=emit, plan=plan)
-                finally:
+                except Exception as e:
+                    # mid-stream failure: an OpenAI-shaped error event so
+                    # clients can tell a died stream from a short success,
+                    # then [DONE] (they block on it); unexpected errors
+                    # still propagate to the server log afterwards
+                    err = {"error": {"message": str(e),
+                                     "type": "invalid_request_error"
+                                     if isinstance(e, ContextOverflow)
+                                     else "server_error"}}
+                    self.wfile.write(f"data: {json.dumps(err)}\n\n".encode())
                     self.wfile.write(b"data: [DONE]\n\n")
                     self.wfile.flush()
+                    if not isinstance(e, ContextOverflow):
+                        raise
+                    return
+                self.wfile.write(b"data: [DONE]\n\n")
+                self.wfile.flush()
                 return
             try:
                 choices, n_prompt, n_completion = state.complete_batch(
@@ -486,6 +575,32 @@ def make_handler(state: ApiState):
 
             created = int(time.time())
             cid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
+            if params.n > 1:
+                if params.stream:
+                    self._json(400, {"error": "stream with n>1 is not "
+                                              "supported; request them "
+                                              "separately"})
+                    return
+                if state.batch_engine is None:
+                    self._json(400, {"error": "n>1 needs batched serving; "
+                                              "start the server with "
+                                              "--batch-slots N"})
+                    return
+                try:
+                    replies, n_prompt, n_completion = state.complete_n(params)
+                except ContextOverflow as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                self._json(200, {
+                    "id": cid, "object": "chat.completion", "created": created,
+                    "model": state.model_name,
+                    "choices": [{"index": i, "finish_reason": "stop",
+                                 "message": {"role": "assistant", "content": r}}
+                                for i, r in enumerate(replies)],
+                    "usage": {"prompt_tokens": n_prompt,
+                              "completion_tokens": n_completion,
+                              "total_tokens": n_prompt + n_completion}})
+                return
             if params.stream:
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")

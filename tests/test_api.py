@@ -237,6 +237,31 @@ def test_completions_streaming_matches_non_stream(server):
         assert finishes[i] == choice["finish_reason"]
 
 
+def test_chat_n_choices(server):
+    """chat completions with n>1 run the templated prompt as one lockstep
+    batch and return n choices (greedy → identical contents)."""
+    body = {"messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 6, "temperature": 0, "seed": 1, "n": 2}
+    with post(server, "/v1/chat/completions", body) as r:
+        data = json.loads(r.read())
+    assert [c["index"] for c in data["choices"]] == [0, 1]
+    contents = [c["message"]["content"] for c in data["choices"]]
+    assert len(set(contents)) == 1  # greedy rows identical
+    # and the single-choice reply matches choice 0
+    single = {**body, "n": 1}
+    with post(server, "/v1/chat/completions", single) as r:
+        one = json.loads(r.read())
+    assert one["choices"][0]["message"]["content"] == contents[0]
+
+
+def test_chat_n_stream_rejected(server):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post(server, "/v1/chat/completions",
+             {"messages": [{"role": "user", "content": "x"}],
+              "n": 2, "stream": True})
+    assert e.value.code == 400
+
+
 def test_completions_stop_string_stream_parity(server):
     """A stop string buried inside the generated text must truncate the
     stream exactly where the non-streaming post-hoc find() truncates."""
