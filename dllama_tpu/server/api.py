@@ -241,6 +241,12 @@ class ApiState:
         its own cache and the NaiveCache is neither consulted nor updated
         (n distinct replies cannot extend one conversation prefix)."""
         eng, tok = self.batch_engine, self.tokenizer
+        if eng is not None and params.n > eng.batch:
+            # tailored message: the client sent ONE prompt with n choices,
+            # not n prompts (the generic _plan_ids wording would mislead)
+            raise ContextOverflow(
+                f"n={params.n} exceeds the {eng.batch} batch slots; lower n "
+                "or restart the server with a larger --batch-slots")
         items = [ChatItem(m.role, m.content) for m in params.messages]
         text = self.template.generate(items, True)
         prompt_tokens = tok.encode(text, add_bos=True)
@@ -252,12 +258,14 @@ class ApiState:
             topp=params.top_p,
             seed=params.seed if params.seed is not None else int(time.time()),
             eos_ids=(eos_id,), chunk=self.chunk)
-        replies = []
+        choices = []
         n_completion = 0
         for r in range(params.n):
             comp = outs[r][len(prompt_tokens):]
+            finish = "length"  # OpenAI truncation signal: cap, no eos
             if comp and comp[-1] == eos_id:
                 comp = comp[:-1]
+                finish = "stop"
             n_completion += len(comp)
             # continuation decode (prev = last prompt token), NOT
             # tok.decode: decode-from-BOS strips a leading space, which the
@@ -268,8 +276,9 @@ class ApiState:
                 cut = reply.find(s)
                 if cut != -1:
                     reply = reply[:cut]
-            replies.append(reply)
-        return replies, len(prompt_tokens), n_completion
+                    finish = "stop"
+            choices.append((reply, finish))
+        return choices, len(prompt_tokens), n_completion
 
     # ------------------------------------------------------------------
     def plan_batch(self, prompts: list[str], max_tokens: int
@@ -323,11 +332,11 @@ class ApiState:
                 finish = "stop"
             n_prompt += len(ids)
             n_completion += len(comp)
-            # continuation decode (see _decode_continuation) — echo
-            # prepends the prompt's own from-BOS decode
-            text = _decode_continuation(tok, ids[-1], comp)
-            if echo:
-                text = tok.decode(ids) + text
+            # continuation decode (see _decode_continuation); echo decodes
+            # prompt+completion as ONE sequence so a UTF-8 codepoint split
+            # across the prompt/completion boundary still reassembles
+            text = tok.decode(ids + comp) if echo \
+                else _decode_continuation(tok, ids[-1], comp)
             for s in stop:
                 cut = text.find(s)
                 if cut != -1:
@@ -374,11 +383,12 @@ class ApiState:
                else eng.seq_len - len(id_lists[r]) for r in range(n_real)]
         done = [False] * n_real
 
-        def flush(r, closing):
+        def flush(r, closing, finish="length"):
             """Scan the row's unsent buffer for stops; emit everything
             safe.  While the row is live, the last ``hold-1`` characters
             stay buffered (a stop could still complete across the
-            boundary); on close the whole buffer goes out."""
+            boundary); on close the whole buffer goes out with ``finish``
+            ("length" at the cap, "stop" when eos fired)."""
             cuts = [c for c in (buf[r].find(s) for s in stop) if c != -1]
             if cuts:
                 emit(r, buf[r][:min(cuts)], "stop")
@@ -387,7 +397,7 @@ class ApiState:
                 return
             if closing:
                 done[r] = True
-                emit(r, buf[r], "length")
+                emit(r, buf[r], finish)
                 buf[r] = ""
             elif hold and len(buf[r]) >= hold:
                 emit(r, buf[r][:len(buf[r]) - (hold - 1)], None)
@@ -407,13 +417,10 @@ class ApiState:
                 n_comp[r] += 1
                 if t == eos_id:
                     # eos text never enters the reply; flush and close as
-                    # "stop" unless a stop string fires in the buffer
+                    # "stop" (a stop string firing in the buffer also ends
+                    # the row as "stop" — flush handles both)
                     buf[r] += decoders[r].decode(b"", True)
-                    cuts = [c for c in (buf[r].find(s) for s in stop)
-                            if c != -1]
-                    emit(r, buf[r][:min(cuts)] if cuts else buf[r], "stop")
-                    buf[r] = ""
-                    done[r] = True
+                    flush(r, closing=True, finish="stop")
                     continue
                 buf[r] += decoders[r].decode(tok.decode_piece(prev[r], t))
                 prev[r] = t
@@ -587,16 +594,16 @@ def make_handler(state: ApiState):
                                               "--batch-slots N"})
                     return
                 try:
-                    replies, n_prompt, n_completion = state.complete_n(params)
+                    n_choices, n_prompt, n_completion = state.complete_n(params)
                 except ContextOverflow as e:
                     self._json(400, {"error": str(e)})
                     return
                 self._json(200, {
                     "id": cid, "object": "chat.completion", "created": created,
                     "model": state.model_name,
-                    "choices": [{"index": i, "finish_reason": "stop",
+                    "choices": [{"index": i, "finish_reason": fin,
                                  "message": {"role": "assistant", "content": r}}
-                                for i, r in enumerate(replies)],
+                                for i, (r, fin) in enumerate(n_choices)],
                     "usage": {"prompt_tokens": n_prompt,
                               "completion_tokens": n_completion,
                               "total_tokens": n_prompt + n_completion}})

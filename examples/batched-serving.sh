@@ -1,0 +1,42 @@
+#!/bin/sh
+# Batched serving of DISTINCT prompts — the throughput lever the reference
+# cannot offer (it is strictly batch=1 per cluster, tasks.cpp:199-210).
+# Decode is weight-bandwidth-bound, so B lockstep streams amortize one
+# weight read over B rows: aggregate tok/s scales ≈linearly with batch.
+#
+# Usage: ./batched-serving.sh model.m tokenizer.t
+set -e
+MODEL=$(realpath "${1:?model.m}")
+TOK=$(realpath "${2:?tokenizer.t}")
+cd "$(dirname "$0")/.."
+
+# 1. Offline: one lockstep ragged batch from a prompts file.  Greedy rows
+#    match the single-stream outputs token for token.
+cat > /tmp/prompts.txt <<'EOF'
+The capital of France is
+Once upon a time
+To be or not to be
+EOF
+python -m dllama_tpu batch --model "$MODEL" --tokenizer "$TOK" \
+    --prompts-file /tmp/prompts.txt --steps 64 --temperature 0
+
+# 2. Serving: /v1/completions accepts a LIST prompt (and n>1) and decodes
+#    every row in one batch; SSE streaming tags chunks by choice index.
+python -m dllama_tpu.server.api --model "$MODEL" --tokenizer "$TOK" \
+    --port 9990 --batch-slots 8 &
+SRV=$!
+trap 'kill $SRV' EXIT
+until curl -s -m 2 http://127.0.0.1:9990/health >/dev/null; do sleep 1; done
+
+curl -s http://127.0.0.1:9990/v1/completions \
+    -H 'Content-Type: application/json' \
+    -d '{"prompt": ["The capital of France is", "Once upon a time"],
+         "max_tokens": 32, "temperature": 0}'
+echo
+
+# n sampled alternatives of one chat prompt, one weight read:
+curl -s http://127.0.0.1:9990/v1/chat/completions \
+    -H 'Content-Type: application/json' \
+    -d '{"messages": [{"role": "user", "content": "Write a haiku"}],
+         "n": 4, "max_tokens": 48, "temperature": 0.9}'
+echo

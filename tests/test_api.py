@@ -245,6 +245,9 @@ def test_chat_n_choices(server):
     with post(server, "/v1/chat/completions", body) as r:
         data = json.loads(r.read())
     assert [c["index"] for c in data["choices"]] == [0, 1]
+    # truncation must be visible per choice (not a hardcoded "stop")
+    assert all(c["finish_reason"] in ("stop", "length")
+               for c in data["choices"])
     contents = [c["message"]["content"] for c in data["choices"]]
     assert len(set(contents)) == 1  # greedy rows identical
     # and the single-choice reply matches choice 0
