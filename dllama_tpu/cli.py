@@ -112,6 +112,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "max context per chip nearly doubles "
                         "(beyond-reference)")
     p.add_argument("--chunk", type=int, default=16, help="on-device decode chunk size")
+    p.add_argument("--pld", type=int, default=0, metavar="K",
+                   help="generate mode, temperature 0: prompt-lookup "
+                        "speculative decoding — propose K tokens from the "
+                        "latest matching n-gram in the context and verify "
+                        "them in ONE forward (beyond-reference; output is "
+                        "exactly the vanilla greedy stream)")
     p.add_argument("--dequantize", action="store_true",
                    help="load Q40 weights as dense bf16 instead of the packed "
                         "fused-kernel path (debugging / numerics comparison)")
@@ -263,6 +269,19 @@ def cmd_generate(args) -> None:
     steps = args.steps or engine.seq_len
     prev = tok.bos_id
     eos = (tok.eos_id,) if tok.eos_id >= 0 else ()
+    if args.pld > 0:
+        if args.temperature != 0:
+            raise SystemExit("--pld is greedy-only; set --temperature 0")
+        if args.dp > 1 or args.sp > 1:
+            raise SystemExit("--pld is single-stream; drop --dp/--sp "
+                             "(tp/ep meshes are fine)")
+        out = engine.generate_pld(ids, steps, k=args.pld, eos_ids=eos)
+        for token in out:
+            sys.stdout.write(tok.decode_piece(prev, token)
+                             .decode("utf-8", errors="replace"))
+            prev = token
+        print()
+        return
     for token, _ in engine.generate_stream(
             ids, steps, temperature=args.temperature, topp=args.topp,
             seed=_seed(args), eos_ids=eos, chunk=args.chunk):

@@ -533,6 +533,114 @@ class Engine:
                 yield toks[j]
             tok_vec = toks[-1]
 
+    # ------------------------------------------------------------------
+    def _verify_fn(self, t: int):
+        """Compiled T-token verification step returning ALL positions'
+        logits (B, T, V) — the speculative-decoding workhorse."""
+        from ..models.transformer import forward
+        key = ("verify", t)
+        if key not in self._chunk_fns:
+            cfg = self.cfg
+
+            def verify(p, c, toks, pos):
+                logits, c = forward(p, cfg, toks, c, pos)
+                # argmax ON DEVICE: only T int32 ids cross the host
+                # boundary, not (T, V) logits — the same boundary
+                # discipline as the decode chunk (a 128k vocab would
+                # otherwise ship ~4 MB per window over the tunnel)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), c
+
+            self._chunk_fns[key] = jax.jit(
+                verify, donate_argnums=(1,),
+                out_shardings=(self._rep, self._cache_sh))
+        return self._chunk_fns[key]
+
+    def generate_pld(self, prompt_tokens: list[int], steps: int, *,
+                     ngram: int = 2, k: int = 7,
+                     eos_ids: tuple[int, ...] = ()) -> list[int]:
+        """Greedy decode with prompt-lookup speculation (beyond reference).
+
+        Draft-model-free speculative decoding: propose the ``k`` tokens
+        that followed the most recent occurrence of the current ``ngram``
+        suffix earlier in the sequence, then verify the whole window in
+        ONE ``T=k+1`` forward.  Decode is weight-bandwidth-bound, so a
+        verify step reads the weights once for up to ``k+1`` accepted
+        tokens — on repetitive continuations (summarization, code, quoted
+        context) this multiplies tokens/weight-read by the acceptance
+        rate.  Rejected proposals cost nothing extra: the cache rows they
+        wrote sit beyond the live prefix (``pos`` only advances over
+        accepted tokens) and are overwritten by the next window, exactly
+        like bucketed-prefill padding.
+
+        Output is EXACTLY the vanilla greedy stream (tests pin
+        ``generate_pld == generate_stream`` token for token): every
+        emitted token is an argmax of the true model distribution at its
+        position — speculation only changes how many positions one
+        dispatch verifies.
+        """
+        if self.batch != 1:
+            raise ValueError("speculative decode is single-stream (batch=1)")
+        if self.sp > 1:
+            raise ValueError("speculative decode is not supported on sp meshes")
+        steps = min(steps, self.seq_len - self.pos)
+        out = list(prompt_tokens)
+        logits, _ = self.prefill(prompt_tokens[:])
+        if len(out) >= steps:
+            return out  # the prompt always echoes whole (stream contract)
+        cur = int(np.asarray(logits)[0].argmax())
+        out.append(cur)
+        if cur in eos_ids:
+            return out
+
+        def propose() -> list[int]:
+            """Continuation after the latest earlier occurrence of the
+            current ngram-suffix; zeros when none (wrong guesses merely
+            verify short)."""
+            if len(out) > ngram:
+                suffix = out[-ngram:]
+                hist = out[:-1]  # a match ending at the suffix itself is useless
+                for i in range(len(hist) - ngram, -1, -1):
+                    if hist[i:i + ngram] == suffix:
+                        cand = out[i + ngram:i + ngram + k]
+                        return cand + [0] * (k - len(cand))
+            return [0] * k
+
+        fn = self._verify_fn(k + 1)
+        while len(out) < steps and self.pos + k + 1 <= self.seq_len:
+            window = np.asarray([[cur] + propose()], np.int32)  # (1, k+1)
+            p0 = self.pos
+            with active_mesh(self.mesh):
+                preds_dev, self.cache = fn(
+                    self.params, self.cache, jnp.asarray(window),
+                    jnp.int32(p0))
+            preds = np.asarray(preds_dev)[0]  # (k+1,) int32
+            accepted = 0
+            while accepted < k and window[0, accepted + 1] == preds[accepted]:
+                accepted += 1
+            # every verified position's argmax is a true greedy token: the
+            # `accepted` matching proposals plus the model's own next token
+            emit = [int(t) for t in preds[:accepted + 1]]
+            base = len(out)
+            out.extend(emit)
+            # the window's first `accepted+1` fed tokens are now part of
+            # the sequence; rows written beyond that are dead (never
+            # attended: the causal mask reads s_idx <= pos)
+            self.pos = p0 + accepted + 1
+            cur = emit[-1]
+            for j, t in enumerate(emit):
+                if t in eos_ids or base + j + 1 >= steps:
+                    del out[base + j + 1:]
+                    self.pos = p0 + j + 1
+                    return out
+        # tail: plain single-token steps when the window no longer fits
+        while len(out) < steps and self.pos < self.seq_len:
+            logits, _ = self.decode_one(cur)
+            cur = int(np.asarray(logits)[0].argmax())
+            out.append(cur)
+            if cur in eos_ids:
+                break
+        return out
+
     def generate(self, prompt_tokens: list[int], steps: int, sampler: Sampler,
                  eos_ids: tuple[int, ...] = (), prefill_single_token: bool = False):
         """Yield ``(token_id, stats)`` for up to ``steps`` generated tokens.

@@ -53,6 +53,20 @@ def test_ragged_batch_moe_matches_single_stream():
     assert outs == [s1, s2]
 
 
+def test_ragged_batch_grok_matches_single_stream():
+    """Grok-1's structural extras (embedding scale, post-sub-block norms,
+    GELU MoE, logit scale) must compose with per-row offsets exactly like
+    the plain arch."""
+    from dllama_tpu.io import mfile
+    cfg = tiny_config(arch=mfile.ARCH_GROK1, n_experts=4, n_active_experts=2,
+                      seq_len=64)
+    e = Engine(cfg, init_params(cfg, seed=4),
+               mesh=make_mesh(tp=1, devices=jax.devices()[:1]), batch=2)
+    s1 = single_stream(P1, 12, cfg=cfg, temperature=0.0, chunk=4)
+    s2 = single_stream(P2, 12, cfg=cfg, temperature=0.0, chunk=4)
+    assert e.generate_batch([P1, P2], 12, temperature=0.0, chunk=4) == [s1, s2]
+
+
 def test_ragged_batch_per_row_eos():
     """EOS must stop ONLY its own row; other rows keep decoding, and the
     finished row's sequence ends exactly at its EOS token."""

@@ -70,6 +70,18 @@ def test_batch_mode_distinct_streams(model_files, tmp_path):
     assert text_only(run_cli(args).stdout) == text_only(r.stdout)  # greedy determinism
 
 
+def test_generate_pld_matches_plain_greedy(model_files):
+    """--pld must print exactly the vanilla greedy text (speculation only
+    changes how many positions one dispatch verifies)."""
+    m, t = model_files
+    base = ["generate", "--model", m, "--tokenizer", t, "--prompt", "hello",
+            "--steps", "24", "--temperature", "0"]
+    plain = run_cli(base)
+    pld = run_cli(base + ["--pld", "5"])
+    assert pld.returncode == 0, pld.stderr[-2000:]
+    assert pld.stdout == plain.stdout
+
+
 def test_batch_mode_requires_prompts(model_files):
     m, t = model_files
     r = run_cli(["batch", "--model", m, "--tokenizer", t])

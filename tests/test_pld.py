@@ -1,0 +1,81 @@
+"""Prompt-lookup speculative decoding (beyond reference — the reference
+has no speculation).  The whole contract is EXACTNESS: every emitted token
+is a true-greedy argmax, so `generate_pld` must reproduce the vanilla
+greedy stream token for token no matter how many proposals get accepted
+or rejected."""
+
+import jax
+import numpy as np
+import pytest
+
+from dllama_tpu.models.config import tiny_config
+from dllama_tpu.models.params import init_params
+from dllama_tpu.parallel.mesh import make_mesh
+from dllama_tpu.runtime.engine import Engine
+
+CFG = tiny_config(seq_len=96)
+
+
+def make_engine(batch=1):
+    return Engine(CFG, init_params(CFG, seed=4),
+                  mesh=make_mesh(tp=1, devices=jax.devices()[:1]), batch=batch)
+
+
+PROMPTS = [
+    [5, 9, 2],
+    [7, 3, 11, 4, 6, 1, 8],
+    [2, 3, 4, 2, 3, 4, 2, 3, 4, 2, 3],  # repetitive → real acceptances
+]
+
+
+@pytest.mark.parametrize("k,ngram", [(5, 2), (3, 3), (7, 1)])
+def test_pld_exactly_matches_vanilla_greedy(k, ngram):
+    for prompt in PROMPTS:
+        ref = [t for t, _ in make_engine().generate_stream(
+            prompt, 40, temperature=0.0, chunk=8)]
+        pld = make_engine().generate_pld(prompt, 40, ngram=ngram, k=k)
+        assert pld == ref, (prompt, k, ngram)
+
+
+def test_pld_echoes_whole_prompt_when_steps_small():
+    """generate_stream echoes the full prompt before the steps check; so
+    must generate_pld."""
+    prompt = [5, 9, 2, 7, 1]
+    ref = [t for t, _ in make_engine().generate_stream(prompt, 3,
+                                                       temperature=0.0)]
+    assert make_engine().generate_pld(prompt, 3) == ref == prompt
+
+
+def test_pld_eos_truncates_like_vanilla():
+    ref = [t for t, _ in make_engine().generate_stream(
+        [5, 9, 2], 40, temperature=0.0, chunk=8)]
+    eos = ref[10]
+    want = [t for t, _ in make_engine().generate_stream(
+        [5, 9, 2], 40, temperature=0.0, chunk=8, eos_ids=(eos,))]
+    got = make_engine().generate_pld([5, 9, 2], 40, ngram=2, k=5,
+                                     eos_ids=(eos,))
+    assert got == want
+    assert got[-1] == eos
+
+
+def test_pld_continues_usable_after_run():
+    """The dead cache rows a rejected window wrote must never poison a
+    later decode: pos-accounting keeps them beyond the live prefix."""
+    e = make_engine()
+    first = e.generate_pld([5, 9, 2], 24, ngram=2, k=5)
+    # same engine, fresh conversation
+    e.reset()
+    again = e.generate_pld([5, 9, 2], 24, ngram=2, k=5)
+    assert first == again
+
+
+def test_pld_rejects_batch_and_sp():
+    with pytest.raises(ValueError, match="single-stream"):
+        make_engine(batch=2).generate_pld([1, 2], 8)
+    if len(jax.devices()) >= 2:
+        cfg = tiny_config(seq_len=64)
+        sp_engine = Engine(cfg, init_params(cfg, seed=4),
+                           mesh=make_mesh(tp=1, sp=2,
+                                          devices=jax.devices()[:2]))
+        with pytest.raises(ValueError, match="sp"):
+            sp_engine.generate_pld([1, 2], 8)
