@@ -116,8 +116,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="generate mode, temperature 0: prompt-lookup "
                         "speculative decoding — propose K tokens from the "
                         "latest matching n-gram in the context and verify "
-                        "them in ONE forward (beyond-reference; output is "
-                        "exactly the vanilla greedy stream)")
+                        "them in ONE forward (beyond-reference; a valid "
+                        "greedy stream — bit-identical to plain greedy up "
+                        "to argmax near-ties between the T=1 and T=K+1 "
+                        "forwards' reduction orders)")
     p.add_argument("--dequantize", action="store_true",
                    help="load Q40 weights as dense bf16 instead of the packed "
                         "fused-kernel path (debugging / numerics comparison)")
@@ -275,10 +277,11 @@ def cmd_generate(args) -> None:
         if args.dp > 1 or args.sp > 1:
             raise SystemExit("--pld is single-stream; drop --dp/--sp "
                              "(tp/ep meshes are fine)")
-        out = engine.generate_pld(ids, steps, k=args.pld, eos_ids=eos)
-        for token in out:
+        for token in engine.generate_pld_stream(ids, steps, k=args.pld,
+                                                eos_ids=eos):
             sys.stdout.write(tok.decode_piece(prev, token)
                              .decode("utf-8", errors="replace"))
+            sys.stdout.flush()  # text appears per verify window, not at end
             prev = token
         print()
         return

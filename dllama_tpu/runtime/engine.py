@@ -572,41 +572,74 @@ class Engine:
         accepted tokens) and are overwritten by the next window, exactly
         like bucketed-prefill padding.
 
-        Output is EXACTLY the vanilla greedy stream (tests pin
-        ``generate_pld == generate_stream`` token for token): every
-        emitted token is an argmax of the true model distribution at its
+        Output is the vanilla greedy stream (tests pin ``generate_pld ==
+        generate_stream`` token for token on the CPU test mesh): every
+        emitted token is an argmax of the model distribution at its
         position — speculation only changes how many positions one
-        dispatch verifies.
+        dispatch verifies.  Hardware caveat: the ``T=k+1`` forward may
+        reduce bf16 matmuls in a different order than the ``T=1`` decode
+        forward, so an argmax near-tie can resolve differently on a real
+        chip; both streams are valid greedy decodes of the model, but
+        bit-identity across the two is only guaranteed where reduction
+        order matches.
         """
+        return list(self.generate_pld_stream(prompt_tokens, steps,
+                                             ngram=ngram, k=k,
+                                             eos_ids=eos_ids))
+
+    def generate_pld_stream(self, prompt_tokens: list[int], steps: int, *,
+                            ngram: int = 2, k: int = 7,
+                            eos_ids: tuple[int, ...] = ()):
+        """Generator core of :meth:`generate_pld`: yields the prompt echo,
+        then each verified token as its window lands — so the CLI streams
+        text during speculation exactly like plain greedy decode."""
         if self.batch != 1:
             raise ValueError("speculative decode is single-stream (batch=1)")
         if self.sp > 1:
             raise ValueError("speculative decode is not supported on sp meshes")
         steps = min(steps, self.seq_len - self.pos)
         out = list(prompt_tokens)
+        # latest-occurrence n-gram index, maintained incrementally: O(1)
+        # lookup per window instead of an O(context) rescan (the host
+        # would otherwise idle the device at exactly the long-context
+        # lengths speculation targets).  Value = position AFTER the match;
+        # only positions ≤ len(out)-1 are indexed, so a lookup never
+        # matches the current suffix against itself (the continuation
+        # would be empty).
+        index: dict[tuple, int] = {}
+        indexed = ngram - 1
+
+        def extend_index():
+            nonlocal indexed
+            hi = len(out) - 1
+            for p in range(max(indexed + 1, ngram), hi + 1):
+                index[tuple(out[p - ngram:p])] = p
+            indexed = max(indexed, hi)
+
         logits, _ = self.prefill(prompt_tokens[:])
+        yield from out
         if len(out) >= steps:
-            return out  # the prompt always echoes whole (stream contract)
+            return  # the prompt always echoes whole (stream contract)
         cur = int(np.asarray(logits)[0].argmax())
         out.append(cur)
+        yield cur
         if cur in eos_ids:
-            return out
+            return
 
         def propose() -> list[int]:
             """Continuation after the latest earlier occurrence of the
             current ngram-suffix; zeros when none (wrong guesses merely
             verify short)."""
             if len(out) > ngram:
-                suffix = out[-ngram:]
-                hist = out[:-1]  # a match ending at the suffix itself is useless
-                for i in range(len(hist) - ngram, -1, -1):
-                    if hist[i:i + ngram] == suffix:
-                        cand = out[i + ngram:i + ngram + k]
-                        return cand + [0] * (k - len(cand))
+                i = index.get(tuple(out[-ngram:]))
+                if i is not None:
+                    cand = out[i:i + k]
+                    return cand + [0] * (k - len(cand))
             return [0] * k
 
         fn = self._verify_fn(k + 1)
         while len(out) < steps and self.pos + k + 1 <= self.seq_len:
+            extend_index()
             window = np.asarray([[cur] + propose()], np.int32)  # (1, k+1)
             p0 = self.pos
             with active_mesh(self.mesh):
@@ -628,18 +661,19 @@ class Engine:
             self.pos = p0 + accepted + 1
             cur = emit[-1]
             for j, t in enumerate(emit):
+                yield t
                 if t in eos_ids or base + j + 1 >= steps:
                     del out[base + j + 1:]
                     self.pos = p0 + j + 1
-                    return out
+                    return
         # tail: plain single-token steps when the window no longer fits
         while len(out) < steps and self.pos < self.seq_len:
             logits, _ = self.decode_one(cur)
             cur = int(np.asarray(logits)[0].argmax())
             out.append(cur)
+            yield cur
             if cur in eos_ids:
                 break
-        return out
 
     def generate(self, prompt_tokens: list[int], steps: int, sampler: Sampler,
                  eos_ids: tuple[int, ...] = (), prefill_single_token: bool = False):

@@ -34,6 +34,16 @@ def drain_generation(engine, tokenizer, detector: EosDetector, stream,
             prev = token
             continue
         n_completion += 1
+        # Per-piece decode, NOT an incremental UTF-8 decoder: the
+        # EosDetector's stop arithmetic is character-position-based per
+        # piece, and a decoder that carries dangling bytes into the next
+        # piece shifts those positions (an eos piece would swallow the
+        # carried replacement char; a stop piece's trailing fragment would
+        # flush AFTER the truncation point).  The cost is cosmetic: a
+        # codepoint split across byte-fallback tokens renders as one
+        # U+FFFD per fragment here.  The batched completions stream
+        # (server/api.py complete_batch_stream) reassembles those — its
+        # stop logic is buffer-based, so the carry is safe there.
         piece = tokenizer.decode_piece(prev, token).decode("utf-8", errors="replace")
         prev = token
         res = detector.append(token, piece)

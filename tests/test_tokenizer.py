@@ -247,3 +247,32 @@ def test_long_prompt_encode_is_fast():
     ids = tok.encode(text)
     assert time.time() - t0 < 20.0
     assert tok.decode(ids).strip() == text.strip()
+
+
+def test_drain_generation_split_codepoint_renders_per_fragment():
+    """drain_generation decodes per piece (the EosDetector's stop
+    arithmetic is per-piece character positions, so an incremental
+    decoder that carries bytes across pieces would corrupt eos/stop
+    cuts): a codepoint split across byte-fallback tokens renders as one
+    U+FFFD per fragment.  The batched completions stream reassembles
+    (buffer-based stop logic) — see stream.py for the tradeoff."""
+    from dllama_tpu.runtime.stream import drain_generation
+    from dllama_tpu.tokenizer.eos import EosDetector
+
+    class StubTok:
+        bos_id = 0
+
+        def decode_piece(self, prev, t):
+            #                    '€' = e2 82 ac, split across two tokens
+            return {1: b"\xe2\x82", 2: b"\xac", 3: b"!"}[t]
+
+    class StubEngine:
+        pos = 10
+
+    deltas = []
+    stream = iter([(1, None), (2, None), (3, None)])
+    reply, n, eos = drain_generation(
+        StubEngine(), StubTok(), EosDetector(99, []), stream,
+        n_prompt=0, prompt_end=10, on_delta=deltas.append)
+    assert reply == "\ufffd\ufffd!"
+    assert n == 3 and not eos
