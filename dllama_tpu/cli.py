@@ -100,7 +100,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="multi-host: total process count")
     p.add_argument("--proc-id", type=int, default=None,
                    help="multi-host: this process's id (0 = root)")
-    p.add_argument("--program", choices=["generate", "inference"], default="generate",
+    p.add_argument("--program", choices=list(WORKER_PROGRAMS),
+                   default="generate",
                    help="worker mode: which root program this worker mirrors "
                         "(multi-host SPMD runs the same program on every process)")
     p.add_argument("--max-seq-len", type=int, default=None)
@@ -413,7 +414,14 @@ def cmd_worker(args) -> None:
     if not is_output_process():
         import os
         sys.stdout = open(os.devnull, "w")
-    {"inference": cmd_inference, "generate": cmd_generate}[args.program](args)
+    WORKER_PROGRAMS[args.program](args)
+
+
+# One table drives the --program choices AND the worker dispatch, so a
+# new mirrored program cannot be added to one and missed in the other
+# (chat stays out: interactive, single-host only).
+WORKER_PROGRAMS = {"generate": cmd_generate, "inference": cmd_inference,
+                   "batch": cmd_batch}
 
 
 def main(argv=None) -> None:

@@ -25,10 +25,13 @@ from fixtures import cpu_env, free_port, REPO, write_tiny_model, write_tiny_toke
 from dllama_tpu import quants
 
 
-def _cmd(mode: str, mpath: str, tpath: str, extra: list[str]) -> list[str]:
+def _cmd(mode: str, mpath: str, tpath: str, extra: list[str],
+         prompt_args: list[str] | None = None,
+         steps: str = "20") -> list[str]:
     return [sys.executable, "-m", "dllama_tpu", mode,
-            "--model", mpath, "--tokenizer", tpath, "--prompt", "hello hi",
-            "--steps", "20", "--temperature", "0", "--seed", "1",
+            "--model", mpath, "--tokenizer", tpath,
+            *(prompt_args or ["--prompt", "hello hi"]),
+            "--steps", steps, "--temperature", "0", "--seed", "1",
             "--buffer-float-type", "f32", "--chunk", "8",
             "--workers", "tpu:2"] + extra
 
@@ -71,3 +74,48 @@ def test_nproc2_generate_matches_single_process(tmp_path):
     # only process 0 owns the stream (Gloo's C++ banner on fd 1 is not ours)
     assert "<s>" not in out1 and "extra_" not in out1, out1
     assert p0.stdout.splitlines()[-1] == golden
+
+
+@pytest.mark.slow
+def test_nproc2_ragged_batch_matches_single_process(tmp_path):
+    """Distinct-stream ragged batching over a REAL 2-process tp=2 mesh:
+    the distributed mesh must be invisible — identical stream texts and
+    only process 0 printing (worker mirrors `--program batch`)."""
+    mpath, tpath = str(tmp_path / "toy.m"), str(tmp_path / "toy.t")
+    write_tiny_model(mpath, ftype=quants.F32, vocab_size=128, seq_len=64)
+    write_tiny_tokenizer(tpath, vocab_size=128)
+    pf = str(tmp_path / "prompts.txt")
+    with open(pf, "w") as f:
+        f.write("hello hi\nonce upon\n")
+
+    def cmd(mode, extra):
+        return _cmd(mode, mpath, tpath, extra,
+                    prompt_args=["--prompts-file", pf], steps="16")
+
+    ref = subprocess.run(cmd("batch", []), cwd=REPO, env=cpu_env(2),
+                         capture_output=True, text=True, timeout=300)
+    assert ref.returncode == 0, ref.stdout + ref.stderr
+    golden = [l for l in ref.stdout.splitlines()
+              if not l.startswith(("💡", "Batched", "Generated"))]
+    assert "▶ stream 1" in ref.stdout
+
+    port = free_port()
+    coords = ["--coordinator", f"localhost:{port}", "--nproc", "2"]
+    p1 = subprocess.Popen(
+        cmd("worker", ["--program", "batch"] + coords + ["--proc-id", "1"]),
+        cwd=REPO, env=cpu_env(1), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    try:
+        p0 = subprocess.run(cmd("batch", coords + ["--proc-id", "0"]),
+                            cwd=REPO, env=cpu_env(1), capture_output=True,
+                            text=True, timeout=300)
+        out1, err1 = p1.communicate(timeout=120)
+    finally:
+        if p1.poll() is None:
+            p1.kill()
+    assert p0.returncode == 0, p0.stdout + p0.stderr
+    assert p1.returncode == 0, out1 + err1
+    assert "▶ stream" not in out1, out1  # only process 0 prints
+    got = [l for l in p0.stdout.splitlines()
+           if not l.startswith(("💡", "Batched", "Generated", "[Gloo]"))]
+    assert got == golden
