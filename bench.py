@@ -844,7 +844,21 @@ def main():
     out = banked or _spawn("cpu-tiny", max(min(remaining() - 30, 420), 120),
                            env_extra=cpu_env)
     if out:
-        _emit(out)
+        # even with the TPU unreachable, record the batching lever's
+        # SCALING quantitatively: lockstep batch=8 aggregate vs the
+        # single-stream rate on the same CPU backend (architecture-level
+        # evidence that the distinct-stream batch amortizes the weight
+        # read; r04 lesson — a dead relay must not mean zero evidence)
+        extras = None
+        if remaining() > 200:
+            _bank_term_result(out)  # a kill mid-b8 must emit THIS number
+            b8 = _spawn("cpu-tiny-b8", min(remaining() - 60, 300),
+                        env_extra=cpu_env)
+            if b8 and b8.get("value") and out.get("value"):
+                extras = {"cpu_batch8_agg_toks": b8["value"],
+                          "cpu_batch8_vs_single": round(
+                              b8["value"] / out["value"], 2)}
+        _emit(out, extras)
         return
     # absolute last resort: still print a parseable line
     _emit({"metric": "bench failed (no backend produced a number)",
