@@ -534,6 +534,75 @@ class Engine:
             tok_vec = toks[-1]
 
     # ------------------------------------------------------------------
+    def score_batch(self, sequences: list[list[int]], top_k: int = 0
+                    ) -> tuple[np.ndarray, np.ndarray | None, np.ndarray | None]:
+        """Teacher-force B sequences through ONE left-padded ragged forward
+        and return per-token log-probabilities (beyond reference — the
+        API's ``logprobs``).
+
+        Returns ``(token_lp, top_ids, top_lp)``: ``token_lp[r, j]`` is
+        ``log P(sequences[r][j+1] | prefix)`` for ``j+1 < len(seq_r)``
+        (position 0 has no conditional; rows are right-aligned in a
+        bucketed width, so entry ``j`` lives at padded column
+        ``tok_lp.shape[1] - len(seq_r) + j``; pad columns hold garbage —
+        callers slice by their own lengths).  With ``top_k`` the
+        per-position top-k alternative ids and log-probs come back too.
+        Scoring runs on a scratch cache copy (no donation) and leaves the
+        engine's conversation state untouched except ``reset()``.
+        """
+        from ..models.transformer import forward, init_kv_cache
+        if self.sp > 1:
+            raise ValueError("score_batch is not supported on sp meshes")
+        if len(sequences) != self.batch:
+            raise ValueError(f"{len(sequences)} sequences for batch={self.batch}")
+        if any(len(s) < 2 for s in sequences):
+            raise ValueError("scoring needs ≥2 tokens per sequence")
+        longest = max(len(s) for s in sequences)
+        if longest > self.seq_len:
+            raise ContextOverflow(
+                f"sequence of {longest} exceeds seq_len {self.seq_len}")
+        # bucket the padded length so a serving loop compiles one scoring
+        # program per bucket, not one per distinct request length (extra
+        # left-padding is invisible: offsets grow, masks/RoPE follow)
+        bucket = max(longest, min(_next_bucket(longest), self.seq_len))
+        toks = np.zeros((self.batch, bucket), np.int32)
+        offsets = np.zeros((self.batch,), np.int32)
+        for r, s in enumerate(sequences):
+            toks[r, bucket - len(s):] = s
+            offsets[r] = bucket - len(s)
+        key = ("score", bucket, top_k)
+        if key not in self._chunk_fns:
+            cfg = self.cfg
+
+            def score(p, c, tk, off):
+                logits, _ = forward(p, cfg, tk, c, jnp.int32(0), offsets=off)
+                lg = logits.astype(jnp.float32)
+                # normalize via a (B, T) logsumexp instead of materializing
+                # a second full-vocab log_softmax buffer next to the logits
+                lse = jax.scipy.special.logsumexp(lg, axis=-1)  # (B, T)
+                # log P of the NEXT fed token, at the position producing it
+                nxt = jnp.roll(tk, -1, axis=1)  # (B, T); last col garbage
+                tok_lp = jnp.take_along_axis(
+                    lg, nxt[..., None], axis=-1)[..., 0] - lse  # (B, T)
+                if top_k > 0:
+                    tl, ti = jax.lax.top_k(lg, top_k)  # (B, T, k)
+                    return tok_lp, ti.astype(jnp.int32), tl - lse[..., None]
+                return tok_lp, None, None
+
+            # one replicated sharding as a pytree prefix covers however
+            # many array outputs the top_k variant returns
+            self._chunk_fns[key] = jax.jit(score, out_shardings=self._rep)
+        with active_mesh(self.mesh):
+            cache = init_kv_cache(self.cfg, self.batch, bucket,
+                                  dtype=self.cache.k.dtype
+                                  if not self.cache.quantized else None)
+            tok_lp, ti, tl = self._chunk_fns[key](
+                self.params, cache, jnp.asarray(toks), jnp.asarray(offsets))
+        return (np.asarray(tok_lp),
+                None if ti is None else np.asarray(ti),
+                None if tl is None else np.asarray(tl))
+
+    # ------------------------------------------------------------------
     def _verify_fn(self, t: int):
         """Compiled T-token verification step returning ALL positions'
         logits (B, T, V) — the speculative-decoding workhorse."""

@@ -297,7 +297,8 @@ class ApiState:
 
     def complete_batch(self, prompts: list[str], *, temperature: float,
                        top_p: float, max_tokens: int, seed: int | None,
-                       stop: list[str], echo: bool = False
+                       stop: list[str], echo: bool = False,
+                       logprobs: int | None = None
                        ) -> tuple[list[dict], int, int]:
         """Run B distinct prompts as one lockstep batch on ``batch_engine``.
 
@@ -307,6 +308,11 @@ class ApiState:
         caller's 400.  ``stop`` strings truncate post-hoc — batch mode is
         offline-style serving, not token streaming, so the EosDetector's
         incremental hold-back buys nothing here.
+
+        ``logprobs`` (int ≥ 0, OpenAI semantics) scores every returned
+        completion with ONE extra teacher-forced ragged forward
+        (Engine.score_batch): chosen-token log-probs, plus the top-k
+        alternatives per position when > 0.
         """
         eng, tok = self.batch_engine, self.tokenizer
         id_lists, n_real, budget, eos_id = self.plan_batch(prompts, max_tokens)
@@ -316,6 +322,7 @@ class ApiState:
             seed=seed if seed is not None else int(time.time()),
             eos_ids=(eos_id,), chunk=self.chunk)
         choices = []
+        comps = []
         n_prompt = n_completion = 0
         for r in range(n_real):
             ids, out = id_lists[r], outs[r]
@@ -330,6 +337,7 @@ class ApiState:
             if comp and comp[-1] == eos_id:
                 comp = comp[:-1]
                 finish = "stop"
+            comps.append(comp)
             n_prompt += len(ids)
             n_completion += len(comp)
             # continuation decode (see _decode_continuation); echo decodes
@@ -344,7 +352,90 @@ class ApiState:
                     finish = "stop"
             choices.append({"text": text, "index": r,
                             "finish_reason": finish, "logprobs": None})
+        if logprobs is not None and any(comps):
+            self._attach_logprobs(choices, id_lists, comps, n_real,
+                                  int(logprobs), echo)
         return choices, n_prompt, n_completion
+
+    def _attach_logprobs(self, choices, id_lists, comps, n_real, top_k,
+                         echo):
+        """Fill each choice's ``logprobs`` object (OpenAI completions
+        shape) from one teacher-forced scoring forward over the padded
+        batch (Engine.score_batch).
+
+        Alignment contract: ``"".join(tokens)`` equals the choice's
+        ``text`` — piece strings come from an incremental UTF-8 decode (a
+        codepoint split across byte-fallback tokens attributes to its
+        final fragment), tokens past a stop-string truncation are
+        dropped, and with ``echo`` the prompt's tokens lead the list with
+        ``None`` as the first logprob (no conditional for position 0) —
+        all OpenAI completions semantics."""
+        import codecs
+        eng, tok = self.batch_engine, self.tokenizer
+        # pad rows never influence real rows (independent batch rows);
+        # their sequences just need ≥2 tokens for the scorer
+        seqs = [id_lists[r] + comps[r] if r < n_real else list(id_lists[r])
+                for r in range(eng.batch)]
+        seqs = [s if len(s) >= 2 else s + [0] for s in seqs]
+        tok_lp, top_ids, top_lp = eng.score_batch(seqs, top_k=top_k)
+        bucket = tok_lp.shape[1]
+        for r in range(n_real):
+            if not comps[r]:
+                continue
+            text = choices[r]["text"]
+            if echo:
+                # tok.decode renders no piece for a leading BOS — skip it
+                # here too; the first displayed token then has a REAL
+                # conditional (on BOS), so only a truly context-free
+                # position 0 gets the OpenAI null
+                skip = 1 if id_lists[r] and id_lists[r][0] == tok.bos_id else 0
+                seq_tokens = seqs[r][skip:]
+                base = skip
+            else:
+                seq_tokens = comps[r]
+                base = len(id_lists[r])  # seq index of entry 0
+            off = bucket - len(seqs[r])
+            # piece strings via incremental decode so their join equals
+            # the text (which was decoded from joined bytes)
+            dec = codecs.getincrementaldecoder("utf-8")("replace")
+            prev = tok.bos_id if echo else id_lists[r][-1]
+            prevs, pieces = [], []
+            for t in seq_tokens:
+                prevs.append(prev)
+                pieces.append(dec.decode(tok.decode_piece(prev, t)))
+                prev = t
+            tail = dec.decode(b"", True)
+            if tail and pieces:
+                pieces[-1] += tail
+            tokens, lps, tops, offsets_txt = [], [], [], []
+            text_pos = 0
+            for m, piece in enumerate(pieces):
+                if text_pos + len(piece) > len(text):
+                    break  # stop-string truncation: align to the text
+                seq_idx = base + m
+                tokens.append(piece)
+                offsets_txt.append(text_pos)
+                text_pos += len(piece)
+                if seq_idx == 0:  # echo: position 0 has no conditional
+                    lps.append(None)
+                    if top_k > 0:
+                        tops.append(None)
+                    continue
+                col = off + seq_idx - 1
+                lps.append(float(tok_lp[r, col]))
+                if top_k > 0:
+                    # distinct ids can render to the same piece string
+                    # (byte-fallback → U+FFFD): top_k is sorted descending,
+                    # so setdefault keeps the higher logprob on collision
+                    d: dict = {}
+                    for i, l in zip(top_ids[r, col], top_lp[r, col]):
+                        d.setdefault(tok.decode_piece(prevs[m], int(i))
+                                     .decode("utf-8", "replace"), float(l))
+                    tops.append(d)
+            choices[r]["logprobs"] = {
+                "tokens": tokens, "token_logprobs": lps,
+                "top_logprobs": tops if top_k > 0 else None,
+                "text_offset": offsets_txt}
 
     # ------------------------------------------------------------------
     def complete_batch_stream(self, prompts: list[str], *, temperature: float,
@@ -479,8 +570,16 @@ def make_handler(state: ApiState):
                     [str(s) for s in stop] if isinstance(stop, list) else []
                 echo = bool(body.get("echo"))
                 stream = bool(body.get("stream"))
+                logprobs = body.get("logprobs")
+                if logprobs is not None:
+                    logprobs = max(0, min(int(logprobs), 5))  # OpenAI cap
             except (TypeError, ValueError, json.JSONDecodeError) as e:
                 self._json(400, {"error": f"bad request: {e}"})
+                return
+            if stream and logprobs is not None:
+                self._json(400, {"error": "logprobs with stream is not "
+                                          "supported; request them "
+                                          "non-streaming"})
                 return
             if state.batch_engine is None:
                 self._json(400, {"error": "batched serving not enabled; "
@@ -540,7 +639,8 @@ def make_handler(state: ApiState):
             try:
                 choices, n_prompt, n_completion = state.complete_batch(
                     prompts, temperature=temperature, top_p=top_p,
-                    max_tokens=max_tokens, seed=seed, stop=stop, echo=echo)
+                    max_tokens=max_tokens, seed=seed, stop=stop, echo=echo,
+                    logprobs=logprobs)
             except ContextOverflow as e:
                 self._json(400, {"error": str(e)})
                 return

@@ -206,6 +206,62 @@ def test_completions_n_greedy_identical(server):
     assert len(texts) == 3 and len(set(texts)) == 1  # greedy → identical rows
 
 
+def test_completions_logprobs(server):
+    """OpenAI logprobs: chosen-token log-probs + top-k alternatives from
+    one teacher-forced scoring forward.  Greedy decode means every chosen
+    token IS the argmax, so its logprob must equal the top-1 logprob."""
+    body = {"prompt": ["the sky", "one two"], "max_tokens": 5,
+            "temperature": 0, "seed": 1, "logprobs": 2}
+    with post(server, "/v1/completions", body) as r:
+        data = json.loads(r.read())
+    for c in data["choices"]:
+        lp = c["logprobs"]
+        assert lp is not None
+        n = len(lp["tokens"])
+        assert n > 0
+        assert len(lp["token_logprobs"]) == n == len(lp["top_logprobs"]) \
+            == len(lp["text_offset"])
+        assert "".join(lp["tokens"]) == c["text"]
+        assert all(v <= 0.0 for v in lp["token_logprobs"])
+        for chosen, tops in zip(lp["token_logprobs"], lp["top_logprobs"]):
+            # distinct token ids may render to one piece string (byte
+            # fallback), so ≤ k entries survive the text keying
+            assert 1 <= len(tops) <= 2
+            assert abs(chosen - max(tops.values())) < 1e-4  # greedy = argmax
+
+
+def test_completions_logprobs_echo_and_stop_alignment(server):
+    """echo=true leads with the prompt's tokens (first logprob null, no
+    conditional for position 0); a stop-string truncation drops the
+    scored tokens past the cut so the list aligns with the text."""
+    body = {"prompt": "the sky", "max_tokens": 6, "temperature": 0,
+            "seed": 1, "logprobs": 0, "echo": True}
+    with post(server, "/v1/completions", body) as r:
+        c = json.loads(r.read())["choices"][0]
+    lp = c["logprobs"]
+    # the fixture tokenizer adds BOS, so even the first displayed token
+    # has a real conditional (the OpenAI null applies only to a truly
+    # context-free position 0); prompt tokens lead the list
+    assert all(v is not None for v in lp["token_logprobs"])
+    assert len(lp["tokens"]) > 6 // 2  # prompt pieces + completion pieces
+    assert "".join(lp["tokens"]) == c["text"]
+    assert lp["text_offset"] == sorted(lp["text_offset"])
+
+    plain = {"prompt": "the sky", "max_tokens": 8, "temperature": 0, "seed": 1}
+    with post(server, "/v1/completions", plain) as r:
+        full = json.loads(r.read())["choices"][0]["text"]
+    if len(full) < 4:
+        pytest.skip("fixture generated too little text to cut")
+    stop = full[len(full) // 2:len(full) // 2 + 2]
+    with post(server, "/v1/completions",
+              {**plain, "stop": [stop], "logprobs": 0}) as r:
+        c = json.loads(r.read())["choices"][0]
+    joined = "".join(c["logprobs"]["tokens"])
+    assert c["text"].startswith(joined)  # a stop can cut mid-piece
+    assert stop not in joined
+    assert len(c["logprobs"]["token_logprobs"]) == len(c["logprobs"]["tokens"])
+
+
 def test_completions_over_slots_is_400(server):
     with pytest.raises(urllib.error.HTTPError) as e:
         post(server, "/v1/completions",
