@@ -352,9 +352,20 @@ class ApiState:
                     finish = "stop"
             choices.append({"text": text, "index": r,
                             "finish_reason": finish, "logprobs": None})
-        if logprobs is not None and any(comps):
-            self._attach_logprobs(choices, id_lists, comps, n_real,
-                                  int(logprobs), echo)
+        if logprobs is not None and n_real:
+            # even with every completion empty (e.g. EOS first): echo rows
+            # still owe the prompt's logprobs, non-echo rows empty lists —
+            # OpenAI shape either way, never a silent null.  The empty
+            # non-echo shape needs no scoring forward, so skip it.
+            if echo or any(comps):
+                self._attach_logprobs(choices, id_lists, comps, n_real,
+                                      int(logprobs), echo)
+            else:
+                for r in range(n_real):
+                    choices[r]["logprobs"] = {
+                        "tokens": [], "token_logprobs": [],
+                        "top_logprobs": [] if int(logprobs) > 0 else None,
+                        "text_offset": []}
         return choices, n_prompt, n_completion
 
     def _attach_logprobs(self, choices, id_lists, comps, n_real, top_k,
@@ -380,16 +391,16 @@ class ApiState:
         tok_lp, top_ids, top_lp = eng.score_batch(seqs, top_k=top_k)
         bucket = tok_lp.shape[1]
         for r in range(n_real):
-            if not comps[r]:
-                continue
             text = choices[r]["text"]
             if echo:
                 # tok.decode renders no piece for a leading BOS — skip it
                 # here too; the first displayed token then has a REAL
                 # conditional (on BOS), so only a truly context-free
-                # position 0 gets the OpenAI null
+                # position 0 gets the OpenAI null.  Walk the REAL sequence,
+                # not seqs[r], which may carry the scorer's min-length pad
+                # token at the end
                 skip = 1 if id_lists[r] and id_lists[r][0] == tok.bos_id else 0
-                seq_tokens = seqs[r][skip:]
+                seq_tokens = (id_lists[r] + comps[r])[skip:]
                 base = skip
             else:
                 seq_tokens = comps[r]
@@ -585,6 +596,13 @@ def make_handler(state: ApiState):
                 self._json(400, {"error": "batched serving not enabled; "
                                           "start the server with --batch-slots N"})
                 return
+            if logprobs is not None and state.batch_engine.sp > 1:
+                # reject BEFORE the generation forward: score_batch raises
+                # on sp meshes, and the handler must answer 400, not drop
+                # the connection after burning the decode
+                self._json(400, {"error": "logprobs is not supported on "
+                                          "sequence-parallel (--sp) servers"})
+                return
             created = int(time.time())
             cid = f"cmpl-{uuid.uuid4().hex[:12]}"
             if stream:
@@ -775,6 +793,14 @@ def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     # reuse the dllama flag surface; the server has no positional mode
     args = build_parser().parse_args(["inference", *argv])
+    if args.batch_slots > 0 and args.sp > 1:
+        # the batch engine's ragged prefill needs the whole sequence axis
+        # per shard (engine.prefill_ragged); accepting the flag would make
+        # every /v1/completions request die mid-handler instead of this
+        # one clear startup error — raised BEFORE the (minutes-long) model
+        # load
+        raise SystemExit("--batch-slots is not supported with --sp "
+                         "(sequence-sharded KV cache); drop one of them")
     engine, tok = load_stack(args)
     batch_engine = None
     if args.batch_slots > 0:

@@ -367,3 +367,49 @@ def test_concurrent_requests_serialize(server):
     for d in results.values():
         assert d["choices"][0]["message"]["role"] == "assistant"
         assert d["usage"]["completion_tokens"] > 0
+
+
+def test_completions_echo_empty_completion_logprobs(tmp_path, monkeypatch):
+    """echo=true with an EOS-first (empty) completion still returns the
+    prompt's logprobs (OpenAI echo semantics), and a non-echo empty
+    completion gets empty lists — never a silent null."""
+    import jax
+
+    from dllama_tpu.models.config import tiny_config
+    from dllama_tpu.models.params import init_params
+    from dllama_tpu.parallel.mesh import make_mesh
+    from dllama_tpu.runtime.engine import Engine
+    from dllama_tpu.server.api import ApiState
+    from dllama_tpu.tokenizer.bpe import Tokenizer
+
+    tok = Tokenizer(write_tiny_tokenizer(str(tmp_path / "tok.t")))
+    cfg = tiny_config(seq_len=64, vocab_size=300)
+    eng = Engine(cfg, init_params(cfg, seed=4),
+                 mesh=make_mesh(tp=1, devices=jax.devices()[:1]), batch=2)
+    state = ApiState(eng, tok, batch_engine=eng)
+    eos = tok.eos_id
+
+    def eos_first(id_lists, budget, **kw):  # every row: EOS immediately
+        return [list(ids) + [eos] for ids in id_lists]
+
+    monkeypatch.setattr(eng, "generate_batch", eos_first)
+    kw = dict(temperature=0.0, top_p=1.0, max_tokens=4, seed=1, stop=[])
+
+    choices, _, n_completion = state.complete_batch(
+        ["hello", "hi"], echo=True, logprobs=0, **kw)
+    assert n_completion == 0
+    for c, prompt in zip(choices, ["hello", "hi"]):
+        assert c["text"] == prompt and c["finish_reason"] == "stop"
+        lp = c["logprobs"]
+        assert lp is not None
+        assert "".join(lp["tokens"]) == prompt
+        # fixture adds BOS, so every displayed prompt token has a real
+        # conditional — no leading null
+        assert len(lp["token_logprobs"]) == len(lp["tokens"]) > 0
+        assert all(v is not None and v <= 0.0 for v in lp["token_logprobs"])
+
+    choices, _, _ = state.complete_batch(["hello", "hi"], logprobs=0, **kw)
+    for c in choices:
+        assert c["text"] == "" and c["logprobs"] == {
+            "tokens": [], "token_logprobs": [], "top_logprobs": None,
+            "text_offset": []}

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import os
+import select
 import signal
 import socket
 import subprocess
@@ -58,9 +59,25 @@ def test_sigterm_emits_last_resort_line():
     env["BENCH_BUDGET_S"] = "3000"
     env["BENCH_RELAY_PORT"] = str(free_port())  # guaranteed-dead relay
     p = subprocess.Popen([sys.executable, os.path.join(REPO, "bench.py")],
-                         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                          env=env, cwd=REPO)
-    time.sleep(3)  # inside the poll loop, nothing emitted yet
+    # Wait for the poll-loop stderr marker before killing: it prints after
+    # the term handler is installed, so the SIGTERM provably races nothing.
+    # (A fixed sleep flaked when a parallel TPU bench starved this child's
+    # interpreter startup past the margin.)  select() bounds the wait even
+    # if the child goes silent before the marker.
+    deadline = time.time() + 120
+    buf = b""
+    while b"polling for tunnel" not in buf and time.time() < deadline:
+        r, _, _ = select.select([p.stderr], [], [],
+                                max(0.0, deadline - time.time()))
+        if not r:
+            break
+        chunk = os.read(p.stderr.fileno(), 4096)
+        if not chunk:
+            break
+        buf += chunk
+    p.stderr.close()
     p.send_signal(signal.SIGTERM)
     out, _ = p.communicate(timeout=30)
     assert p.returncode == 1
