@@ -377,6 +377,7 @@ def run_attempt(name):
 
     batch = 1
     kv_quant = False
+    profile = False
     if name.endswith("-b8"):
         name, batch = name[:-3], 8
     if name.endswith("-q8kv"):
@@ -384,19 +385,45 @@ def run_attempt(name):
         # step, so this should show ~2× less attention time than the bf16
         # run (beyond-reference capability, models/transformer.py)
         name, kv_quant = name[:-5], True
+    if name.endswith("-profile"):
+        # xplane profiling rides its OWN attempt, run as the LAST hardware
+        # stage: in the r05 window the in-stage profiler left the tunneled
+        # chip's exclusive claim wedged — every later client (including a
+        # bare jax.devices()) hung until the relay died.  Isolating it
+        # means a wedge costs only the optional diagnostics, never a
+        # headline or extras stage.
+        name, profile = name[:-8], True
+    chunk_override = None
+    if "-c" in name and name.rsplit("-c", 1)[-1].isdigit():
+        # decode chunk-size probe: per-token wall cost = compute + (per-
+        # chunk dispatch overhead)/chunk, and the r05 window measured that
+        # overhead at ~75 ms/chunk over the tunnel — a larger K amortizes
+        # it (runtime/decode_loop.py K-step chunk; --chunk on the CLI)
+        name, c = name.rsplit("-c", 1)
+        chunk_override = int(c)
     cfg = _model_cfg(name)
     if name == "cpu-tiny":
         impl, chunk, n_chunks = "xla", 16, 2
     else:
+        # the claim marker makes a wedged tunnel diagnosable: if the next
+        # line never appears, the child hung acquiring the chip, not in
+        # compile or decode (the r05 post-profile failure signature)
+        print(f"bench: {name}: claiming backend...", file=sys.stderr)
+        print(f"bench: {name}: backend {jax.default_backend()}", file=sys.stderr)
         impl = _pallas_hw_check()
         chunk, n_chunks = 32, 10  # ≥10 timed chunks (ADVICE r02)
+    if profile:
+        n_chunks = 2  # the split needs one traced chunk, not a full rerun
+    if chunk_override:
+        # keep the ≥10-timed-chunks evidence standard (ADVICE r02) even for
+        # probes: a promoted chunk-size headline must rest on the same
+        # sample count as the number it replaces
+        chunk, n_chunks = chunk_override, 10
     cfg = cfg.with_(quant_impl=impl)
     # long-context evidence decodes deep in the cache (live prefix ~15.7k),
     # otherwise the "16k" number would really measure a ~350-token prefix
     start = cfg.seq_len - 64 - (n_chunks + 2) * chunk if name.endswith("-long") else 0
-    ms = _bench_decode(cfg, chunk=chunk, n_chunks=n_chunks,
-                       profile=(name == "llama2-7b" and batch == 1
-                                and not kv_quant),
+    ms = _bench_decode(cfg, chunk=chunk, n_chunks=n_chunks, profile=profile,
                        start_pos=start, batch=batch, kv_quant=kv_quant)
     toks = batch * 1000.0 / ms
     backend = jax.default_backend()
@@ -432,6 +459,8 @@ def run_attempt(name):
         # reference's only published Llama-3 numbers are RasPi multi-node
     elif name == "llama2-7b":
         metric = f"llama2-7b q40 greedy decode tok/s (1 TPU chip, {impl})"
+        if chunk_override:
+            metric += f" [chunk={chunk}]"
         vs = round(toks / BASELINE_7B_TOKS, 2)
     elif name == "tinyllama-1.1b":
         metric = f"tinyllama-1.1b q40 greedy decode tok/s (1 TPU chip, {impl})"
@@ -822,6 +851,12 @@ def main():
                 extras["llama2-7b_16k_q8kv_toks"] = q8kv_out["value"]
                 print(f"bench: int8-KV long-context: {json.dumps(q8kv_out)}",
                       file=sys.stderr)
+        # xplane I/T-split diagnostics run DEAD LAST: the r05 window showed
+        # the tunnel profiler can wedge the chip's exclusive claim, hanging
+        # every subsequent client — after this stage there is nothing left
+        # to lose (the emit below uses results already in hand)
+        if got_7b and remaining() > RESERVE + 120 and _relay_up():
+            _spawn("llama2-7b-profile", min(remaining() - RESERVE, 300))
         if cli_out:
             print(f"bench: decode_chunk cross-check: {json.dumps(chunk_out)}",
                   file=sys.stderr)

@@ -1,0 +1,390 @@
+#!/usr/bin/env python
+"""Incremental TPU-evidence capture for a flaky relay window.
+
+Invoked by tools/tunnel_watch.sh whenever the axon relay answers.  Reads
+what evidence already exists (``BENCH_insession.json`` headline+extras,
+``tools/sweep_results.txt`` kernel probes), runs ONLY the missing stages
+in priority order, merges each result into the artifact the moment it
+lands, and git-commits it — so a tunnel that dies mid-window (the r02-r05
+norm: ~30 min of life, then nothing for hours) never re-burns or loses a
+measurement.
+
+Priority order (each stage gated on the relay still answering, with a
+wedge probe after any timeout — the r05 window showed a killed child can
+leave the chip's exclusive claim stuck, hanging every later client):
+
+  1. llama2-7b headline (only if the artifact is missing/degraded)
+  2. llama3-8b          — the BASELINE.json north-star, never yet measured
+  3. chunk probes       — decode chunk 64/128 amortize the ~75 ms/chunk
+                          tunnel dispatch overhead measured in r05
+  4. tile probes (w13)  — docs/PERF.md lever #1 (tile_d = HBM burst len)
+  5. variant probes     — folded/exact/fma vs classic on w13+wo
+  6. combined re-run    — headline with every winning lever; promoted only
+                          if it beats the recorded number end-to-end
+  7. extras             — batch=8 aggregate, 16k long-context, int8-KV 16k
+  8. moe hw check, xplane profile (diagnostics; profile LAST — it can
+                          wedge the tunnel claim)
+
+Idempotent: run it as many times as the relay flickers; done stages are
+skipped by inspecting the artifacts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ART = os.path.join(HERE, "BENCH_insession.json")
+SWEEP = os.path.join(HERE, "tools", "sweep_results.txt")
+BENCH = os.path.join(HERE, "bench.py")
+
+sys.path.insert(0, HERE)
+from bench import _with_compile_cache  # noqa: E402  (shared cache env recipe)
+
+# the in-flight child, killed from the SIGTERM handler: if the watcher's
+# outer timeout tears THIS process down mid-attempt, the bench child must
+# not survive holding the chip's exclusive claim (it would wedge every
+# later capture — the r05 failure mode, self-inflicted)
+_child: subprocess.Popen | None = None
+
+
+def _on_term(signum, frame):
+    if _child is not None and _child.poll() is None:
+        _child.kill()
+    raise SystemExit(7)
+
+
+signal.signal(signal.SIGTERM, _on_term)
+
+RELAY_PORT = int(os.environ.get("BENCH_RELAY_PORT", "8093"))
+RELAY_HOST = (os.environ.get("PALLAS_AXON_POOL_IPS", "").split(",")[0].strip()
+              or "127.0.0.1")
+
+TILE_CONFIGS = [(1024, 1024), (512, 2048), (256, 4096), (512, 4096),
+                (1024, 2048)]
+VARIANTS = ["folded", "fma", "exact"]
+
+
+def log(msg: str) -> None:
+    print(f"hwcap {time.strftime('%H:%M:%S')}: {msg}", file=sys.stderr,
+          flush=True)
+
+
+def relay_up(timeout: float = 3.0) -> bool:
+    try:
+        with socket.create_connection((RELAY_HOST, RELAY_PORT), timeout):
+            return True
+    except OSError:
+        return False
+
+
+def child_env(extra: dict | None = None) -> dict:
+    env = _with_compile_cache(dict(os.environ))
+    env["PYTHONPATH"] = HERE + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra or {})
+    return env
+
+
+def _run(cmd: list, timeout_s: float, env: dict):
+    """subprocess.run equivalent that tracks the child for the SIGTERM
+    handler (the watcher's outer timeout must never orphan a bench child
+    on the chip)."""
+    global _child
+    _child = subprocess.Popen(cmd, stdout=subprocess.PIPE, env=env, cwd=HERE)
+    try:
+        stdout, _ = _child.communicate(timeout=timeout_s)
+        return _child.returncode, stdout
+    except subprocess.TimeoutExpired:
+        _child.kill()
+        _child.communicate()
+        raise
+    finally:
+        _child = None
+
+
+def attempt(name: str, timeout_s: float, env_extra: dict | None = None):
+    """One bench.py --attempt child; stderr streams through to our log."""
+    log(f"attempt {name} (timeout {timeout_s:.0f}s)")
+    t0 = time.time()
+    try:
+        rc, stdout = _run([sys.executable, BENCH, "--attempt", name],
+                          timeout_s, child_env(env_extra))
+    except subprocess.TimeoutExpired:
+        log(f"{name} timed out after {time.time() - t0:.0f}s")
+        return None
+    if rc != 0:
+        log(f"{name} exited rc={rc}")
+        return None
+    try:
+        out = json.loads(stdout.decode().strip().splitlines()[-1])
+    except Exception:
+        log(f"{name} produced no parseable line")
+        return None
+    if out.get("backend") == "cpu":
+        # the tunnel dropped between the window probe and this child: its
+        # jax silently fell back to the host CPU — NOT hardware evidence
+        log(f"{name} ran on the CPU backend (tunnel gone); discarding")
+        return None
+    log(f"{name} ok in {time.time() - t0:.0f}s: {json.dumps(out)}")
+    return out
+
+
+def probe(timeout_s: float = 120) -> bool:
+    out = attempt("probe", timeout_s)
+    return bool(out) and out.get("platform") != "cpu"
+
+
+def wedged() -> bool:
+    """After a timeout: can a fresh client still claim the chip?"""
+    if not relay_up():
+        log("relay died")
+        return True
+    if not probe(90):
+        log("chip claim hangs — tunnel wedged, abandoning this window")
+        return True
+    return False
+
+
+def load_art() -> dict:
+    try:
+        with open(ART) as f:
+            return json.loads(f.read().strip())
+    except Exception:
+        return {}
+
+
+def save_art(art: dict) -> None:
+    with open(ART, "w") as f:
+        f.write(json.dumps(art) + "\n")
+
+
+def commit(msg: str, *paths: str) -> bool:
+    """Commit artifacts, retrying around a build session's index.lock."""
+    for _ in range(5):
+        subprocess.run(["git", "add", "--"] + list(paths), cwd=HERE,
+                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        r = subprocess.run(["git", "commit", "-m", msg, "--"] + list(paths),
+                           cwd=HERE, stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL)
+        if r.returncode == 0:
+            log(f"committed: {msg}")
+            return True
+        time.sleep(7)
+    log(f"could not commit ({msg}); artifact saved on disk")
+    return False
+
+
+def sweep_done() -> set:
+    done = set()
+    try:
+        with open(SWEEP) as f:
+            for line in f:
+                try:
+                    o = json.loads(line)
+                    done.add((o["variant"], o["tile_n"], o["tile_d"],
+                              tuple(sorted(o.get("shapes", {})))))
+                except Exception:
+                    continue
+    except OSError:
+        pass
+    return done
+
+
+def sweep_probe(variant: str, tn: int, td: int, shapes: str,
+                timeout_s: float = 300):
+    """One tools/sweep_q40.py --one run; appends its JSON to SWEEP."""
+    log(f"sweep probe {variant} tn={tn} td={td} shapes={shapes}")
+    try:
+        rc, stdout = _run(
+            [sys.executable, os.path.join(HERE, "tools", "sweep_q40.py"),
+             "--one", variant, str(tn), str(td), "--shapes", shapes],
+            timeout_s, child_env())
+    except subprocess.TimeoutExpired:
+        log("sweep probe timed out")
+        return None
+    if rc != 0 or not stdout:
+        log(f"sweep probe rc={rc}")
+        return None
+    try:
+        out = json.loads(stdout.decode().strip().splitlines()[-1])
+    except Exception:
+        return None
+    if "error" in out or not out.get("shapes"):
+        log(f"sweep probe: {out}")
+        return None
+    with open(SWEEP, "a") as f:
+        f.write(json.dumps(out) + "\n")
+    log(f"sweep probe: {json.dumps(out['shapes'])}")
+    return out
+
+
+def main() -> int:
+    if not relay_up():
+        log("relay not listening")
+        return 2
+    if not probe():
+        log("backend probe failed")
+        return 3
+
+    art = load_art()
+    extras = art.get("extras") or {}
+    hw = bool(art) and art.get("value", 0) > 0 \
+        and "DEGRADED" not in art.get("metric", "")
+
+    def merge_commit(msg):
+        art["extras"] = extras
+        save_art(art)
+        commit(msg, ART)
+
+    # --- 1. headline --------------------------------------------------
+    if not hw:
+        out = attempt("llama2-7b", 900)
+        if out and "llama2-7b" in out.get("metric", ""):
+            art = {k: out.get(k) for k in
+                   ("metric", "value", "unit", "vs_baseline")}
+            hw = True
+            merge_commit("In-session TPU bench capture (headline)")
+        elif wedged():
+            return 4
+        else:
+            return 5  # no headline and no wedge: give the relay a rest
+    baseline_toks = art["value"]
+
+    # --- 2. north-star ------------------------------------------------
+    if "llama3-8b_toks" not in extras:
+        out = attempt("llama3-8b", 900)
+        if out:
+            extras["llama3-8b_toks"] = out["value"]
+            merge_commit("In-session TPU capture: llama3-8b north-star")
+        elif wedged():
+            return 4
+
+    # --- 3. chunk probes ----------------------------------------------
+    for c in (64, 128):
+        key = f"llama2-7b_c{c}_toks"
+        if key in extras:
+            continue
+        if not relay_up():
+            return 6  # stages remain; watcher keeps the fast 60 s poll
+        out = attempt(f"llama2-7b-c{c}", 300)
+        if out:
+            extras[key] = out["value"]
+            if out["value"] > art["value"]:
+                extras.setdefault("llama2-7b_chunk32_toks", baseline_toks)
+                art.update({k: out.get(k) for k in
+                            ("metric", "value", "unit", "vs_baseline")})
+            merge_commit(f"In-session TPU capture: chunk={c} decode probe")
+        elif wedged():
+            return 4
+
+    # --- 4./5. kernel probes ------------------------------------------
+    done = sweep_done()
+    probes = [("classic", tn, td, "w13") for tn, td in TILE_CONFIGS] + \
+             [(v, 1024, 1024, "w13,wo") for v in VARIANTS]
+    ran_probe = False
+    for variant, tn, td, shapes in probes:
+        if (variant, tn, td, tuple(sorted(shapes.split(",")))) in done:
+            continue
+        if not relay_up():
+            return 6  # stages remain; watcher keeps the fast 60 s poll
+        out = sweep_probe(variant, tn, td, shapes)
+        ran_probe = True
+        if out is None and wedged():
+            commit("In-session kernel probe results (partial)", SWEEP)
+            return 4
+    if ran_probe and os.path.exists(SWEEP):
+        commit("In-session kernel probe results", SWEEP)
+
+    # --- 6. combined re-run -------------------------------------------
+    if "combined_rerun_toks" not in extras and os.path.exists(SWEEP):
+        rows = []
+        with open(SWEEP) as f:
+            for line in f:
+                try:
+                    rows.append(json.loads(line))
+                except Exception:
+                    continue
+        w13 = {(o["variant"], o["tile_n"], o["tile_d"]):
+               o["shapes"]["w13"]["ms"] for o in rows if "w13" in o["shapes"]}
+        base_ms = w13.get(("classic", 1024, 1024))
+        env = {}
+        tags = []
+        if base_ms:
+            best = min(w13, key=w13.get)
+            if best[0] == "classic" and best[1:] != (1024, 1024) \
+                    and w13[best] < 0.95 * base_ms:
+                rule = json.dumps([[8192, best[1], best[2]]])
+                env["DLLAMA_Q40_TILES_JSON"] = rule
+                tags.append(f"tiles {rule}")
+            if best[0] != "classic" and w13[best] < 0.95 * base_ms:
+                env["DLLAMA_Q40_VARIANT"] = best[0]
+                tags.append(f"variant {best[0]}")
+        best_c = max((c for c in (64, 128)
+                      if extras.get(f"llama2-7b_c{c}_toks", 0) > baseline_toks),
+                     key=lambda c: extras[f"llama2-7b_c{c}_toks"], default=None)
+        name = f"llama2-7b-c{best_c}" if best_c else "llama2-7b"
+        if env and relay_up():
+            out = attempt(name, 420, env_extra=env)
+            if out:
+                extras["combined_rerun_toks"] = out["value"]
+                if out["value"] > art["value"]:
+                    out["metric"] += " [" + ", ".join(tags) + "]"
+                    extras.setdefault("llama2-7b_default_toks", baseline_toks)
+                    for t in tags:
+                        if t.startswith("tiles"):
+                            extras["tile_rule"] = env["DLLAMA_Q40_TILES_JSON"]
+                        else:
+                            extras["kernel_variant"] = env["DLLAMA_Q40_VARIANT"]
+                    art.update({k: out.get(k) for k in
+                                ("metric", "value", "unit", "vs_baseline")})
+                merge_commit("In-session TPU capture: combined-lever re-run")
+            elif wedged():
+                return 4
+
+    # --- 7. extras ----------------------------------------------------
+    for name, key, msg in (
+            ("llama2-7b-b8", "llama2-7b_batch8_agg_toks", "batch=8 aggregate"),
+            ("llama2-7b-long", "llama2-7b_16k_toks", "16k long-context"),
+            ("llama2-7b-long-q8kv", "llama2-7b_16k_q8kv_toks",
+             "int8-KV 16k long-context")):
+        if key in extras:
+            continue
+        if not relay_up():
+            return 6  # stages remain; watcher keeps the fast 60 s poll
+        out = attempt(name, 360)
+        if out:
+            extras[key] = out["value"]
+            merge_commit(f"In-session TPU capture: {msg}")
+        elif wedged():
+            return 4
+
+    # --- 8. diagnostics (profile LAST: it can wedge the claim) --------
+    if "moe_hw_ok" not in extras and relay_up():
+        try:
+            rc, stdout = _run(
+                [sys.executable, os.path.join(HERE, "tools", "moe_hw_check.py"),
+                 "--layers", "2", "--steps", "8"],
+                300, child_env())
+            tail = stdout.decode().strip().splitlines()[-1] if stdout else ""
+            log(f"moe hw check rc={rc}: {tail}")
+            if rc == 0:
+                extras["moe_hw_ok"] = 1
+                merge_commit("In-session TPU capture: packed-MoE hw check")
+        except subprocess.TimeoutExpired:
+            log("moe hw check timed out")
+            if wedged():
+                return 4
+    if relay_up():
+        attempt("llama2-7b-profile", 300)
+    log("window complete: all stages landed or attempted")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,100 +1,42 @@
 #!/bin/bash
 # All-session relay watcher (VERDICT r04 Next #1): poll the axon relay for
-# the WHOLE build session and, at the first sign of life, capture hardware
-# evidence and commit it — the full bench (headline llama2-7b + llama3-8b +
-# tile auto-tune + long-context extras) to BENCH_insession.json, then the
-# kernel sweep table to tools/sweep_results.txt.  Keeps watching after a
-# capture: later windows refresh a degraded result or add the sweep.
-# r02 proved the tunnel can be up mid-session while dead at round end, and
-# r03+r04 produced zero hardware data by only benching at round end.
+# the WHOLE build session and, at each sign of life, run the incremental
+# gap-filler (tools/hw_capture.py) — it inspects BENCH_insession.json and
+# tools/sweep_results.txt, runs only the missing hardware stages, and
+# commits every artifact the moment it lands.  r02-r05 all showed the same
+# tunnel pattern: ~30 min windows of life separated by hours of nothing,
+# sometimes ending in a wedged chip claim — so capture must be incremental
+# and idempotent, never a monolithic bench that loses everything when the
+# window closes.
 #
 # Liveness marker: /tmp/RELAY_UP exists while the relay answers.
 # Log: /tmp/tunnel_watch.log.
 cd "$(dirname "$0")/.."
 log=/tmp/tunnel_watch.log
-echo "$(date -u +%H:%M:%S) watcher start (pid $$)" >> "$log"
-
-bench_ok=0
-sweep_ok=0
-
-commit_paths() {  # commit_paths <msg> <path>... — retry around index.lock
-    local msg="$1"; shift
-    for i in 1 2 3 4 5; do
-        git add -- "$@" >> "$log" 2>&1
-        if git commit -m "$msg" -- "$@" >> "$log" 2>&1; then return 0; fi
-        sleep 7
-    done
-    return 1
-}
+# same relay address derivation as bench.py/hw_capture.py — the gate and
+# the capture must watch the same endpoint
+RELAY_PORT="${BENCH_RELAY_PORT:-8093}"
+RELAY_HOST="${PALLAS_AXON_POOL_IPS%%,*}"
+RELAY_HOST="${RELAY_HOST:-127.0.0.1}"
+echo "$(date -u +%H:%M:%S) watcher start (pid $$, relay $RELAY_HOST:$RELAY_PORT)" >> "$log"
 
 while true; do
-    code=$(curl -s -m 5 -o /dev/null -w "%{http_code}" http://127.0.0.1:8093/healthz)
-    if [ "$code" = "000" ]; then
+    if ! timeout 6 bash -c "exec 3<>/dev/tcp/$RELAY_HOST/$RELAY_PORT" 2>/dev/null; then
         rm -f /tmp/RELAY_UP
         sleep 60
         continue
     fi
     touch /tmp/RELAY_UP
-    if [ "$bench_ok" = 1 ] && [ "$sweep_ok" = 1 ]; then
-        sleep 120   # everything captured; just maintain the marker
-        continue
+    echo "$(date -u +%H:%M:%S) relay answering — running hw_capture" >> "$log"
+    # SIGTERM on timeout: hw_capture's handler kills its in-flight bench
+    # child so the chip claim is never orphaned; 9000 s covers the worst-
+    # case full-stage window (llama3-8b 900 + probes + extras)
+    timeout 9000 python tools/hw_capture.py >> "$log" 2>&1
+    rc=$?
+    echo "$(date -u +%H:%M:%S) hw_capture rc=$rc" >> "$log"
+    if [ "$rc" = 0 ] || [ "$rc" = 4 ]; then
+        sleep 300   # all landed, or wedged claim cooling off
+    else
+        sleep 60    # stages remain (relay flicker / probe fail): fast poll
     fi
-    echo "$(date -u +%H:%M:%S) relay answered ($code) — probing jax" >> "$log"
-    if ! timeout 180 python -c "import jax; assert jax.default_backend() != 'cpu', 'cpu'; print(jax.devices())" >> "$log" 2>&1; then
-        echo "$(date -u +%H:%M:%S) relay up but jax probe failed" >> "$log"
-        sleep 60
-        continue
-    fi
-    if [ "$bench_ok" = 0 ]; then
-        echo "$(date -u +%H:%M:%S) TPU live — running bench" >> "$log"
-        BENCH_BUDGET_S=1500 timeout 1600 python bench.py \
-            > /tmp/BENCH_insession.json 2>> "$log"
-        rc=$?
-        echo "$(date -u +%H:%M:%S) bench rc=$rc: $(cat /tmp/BENCH_insession.json)" >> "$log"
-        # hardware evidence = a parseable line whose metric is not the
-        # DEGRADED cpu fallback and whose value is non-zero
-        if python - <<'EOF'
-import json, sys
-try:
-    r = json.loads(open("/tmp/BENCH_insession.json").read().strip().splitlines()[-1])
-except Exception:
-    sys.exit(1)
-sys.exit(0 if r.get("value", 0) > 0 and "DEGRADED" not in r.get("metric", "")
-         and "interrupted" not in r.get("metric", "") else 1)
-EOF
-        then
-            # capture succeeded regardless of git state: never re-burn a
-            # 1500 s TPU bench because the build session held index.lock
-            bench_ok=1
-            cp /tmp/BENCH_insession.json BENCH_insession.json
-            bench_committed=0
-            commit_paths "In-session TPU bench capture (relay window)" BENCH_insession.json \
-                && bench_committed=1
-            echo "$(date -u +%H:%M:%S) bench artifact committed=$bench_committed" >> "$log"
-        else
-            echo "$(date -u +%H:%M:%S) bench produced no hardware number" >> "$log"
-        fi
-    fi
-    if [ "$bench_ok" = 1 ] && [ "${bench_committed:-1}" = 0 ]; then
-        commit_paths "In-session TPU bench capture (relay window)" BENCH_insession.json \
-            && bench_committed=1
-    fi
-    if [ "$bench_ok" = 1 ] && [ "$sweep_ok" = 0 ]; then
-        echo "$(date -u +%H:%M:%S) running kernel sweep" >> "$log"
-        timeout 2400 python tools/sweep_q40.py > /tmp/sweep_results.txt 2>> "$log"
-        rc=$?
-        echo "$(date -u +%H:%M:%S) sweep rc=$rc" >> "$log"
-        if [ "$rc" = 0 ] && [ -s /tmp/sweep_results.txt ]; then
-            sweep_ok=1
-            cp /tmp/sweep_results.txt tools/sweep_results.txt
-            sweep_committed=0
-            commit_paths "In-session kernel sweep results (relay window)" tools/sweep_results.txt \
-                && sweep_committed=1
-        fi
-    fi
-    if [ "$sweep_ok" = 1 ] && [ "${sweep_committed:-1}" = 0 ]; then
-        commit_paths "In-session kernel sweep results (relay window)" tools/sweep_results.txt \
-            && sweep_committed=1
-    fi
-    sleep 60
 done
