@@ -868,6 +868,28 @@ def main():
     else:
         print("bench: TPU backend unreachable — degraded CPU mode", file=sys.stderr)
 
+    # the in-session watcher (tools/tunnel_watch.sh + tools/hw_capture.py)
+    # may have banked driver-grade hardware numbers during a relay window
+    # earlier in the round — a dead relay at round end must surface THAT
+    # evidence, clearly labeled, not only a degraded CPU line
+    insession = None
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_insession.json")
+        with open(path) as f:
+            cand = json.loads(f.read().strip())
+        # freshness gate: a capture from THIS round only (rounds run ~12 h;
+        # the artifact is committed, so a later dead-relay round must not
+        # replay it as current evidence).  hw_capture stamps captured_unix;
+        # fall back to the file mtime for artifacts written before that.
+        age_s = time.time() - float(cand.get("captured_unix")
+                                    or os.path.getmtime(path))
+        if cand.get("metric") and cand.get("value", 0) > 0 \
+                and "DEGRADED" not in cand["metric"] and age_s < 12 * 3600:
+            insession = cand
+    except Exception:
+        pass
+
     if banked is None and bank_proc is not None:
         # the background banking child may still be mid-compile — give it
         # the time a fresh spawn would have gotten rather than starting over
@@ -876,6 +898,24 @@ def main():
             bank_proc.kill()
             bank_proc.wait()
             bank_proc = None
+    if insession is not None and not on_hw:
+        # only when the relay is genuinely unreachable: an on-hw run whose
+        # stages all failed keeps the honest degraded path (and its label)
+        print("bench: emitting the committed in-session TPU capture "
+              "(relay down at round end)", file=sys.stderr)
+        insession.pop("captured_unix", None)
+        insession["metric"] += " [in-session capture; relay down at round end]"
+        extras = insession.pop("extras", None) or {}
+        _bank_term_result(dict(insession, **({"extras": extras} if extras else {})))
+        cpu_out = banked or _spawn(
+            "cpu-tiny", max(min(remaining() - 30, 300), 120),
+            env_extra=cpu_env)
+        if cpu_out and cpu_out.get("value"):
+            extras["degraded_cpu_toks"] = cpu_out["value"]
+            # re-bank so a kill after this point carries the cross-check too
+            _bank_term_result(dict(insession, extras=extras))
+        _emit(insession, extras or None)
+        return
     out = banked or _spawn("cpu-tiny", max(min(remaining() - 30, 420), 120),
                            env_extra=cpu_env)
     if out:

@@ -160,6 +160,10 @@ def load_art() -> dict:
 
 
 def save_art(art: dict) -> None:
+    # captured_unix feeds bench.py's round-end freshness gate: a committed
+    # artifact from a PREVIOUS round must not be replayed as current
+    # hardware evidence
+    art["captured_unix"] = time.time()
     with open(ART, "w") as f:
         f.write(json.dumps(art) + "\n")
 
