@@ -881,9 +881,9 @@ def main():
         # freshness gate: a capture from THIS round only (rounds run ~12 h;
         # the artifact is committed, so a later dead-relay round must not
         # replay it as current evidence).  hw_capture stamps captured_unix;
-        # fall back to the file mtime for artifacts written before that.
-        age_s = time.time() - float(cand.get("captured_unix")
-                                    or os.path.getmtime(path))
+        # an unstamped artifact is treated as stale — file mtime would
+        # reset to "now" on any fresh checkout, defeating the gate.
+        age_s = time.time() - float(cand.get("captured_unix") or 0)
         if cand.get("metric") and cand.get("value", 0) > 0 \
                 and "DEGRADED" not in cand["metric"] and age_s < 12 * 3600:
             insession = cand

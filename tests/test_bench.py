@@ -100,6 +100,10 @@ def test_dead_relay_emits_insession_capture():
     art = json.loads(open(art_path).read().strip())
     if not art.get("value") or "DEGRADED" in art.get("metric", ""):
         pytest.skip("in-session artifact is not hardware evidence")
+    age_s = time.time() - float(art.get("captured_unix") or 0)
+    if age_s >= 12 * 3600:  # mirror bench's freshness gate
+        pytest.skip("in-session artifact is stale; bench correctly "
+                    "prefers the degraded path")
     env = dict(os.environ)
     env["BENCH_BUDGET_S"] = "200"
     env["BENCH_RELAY_PORT"] = str(free_port())  # guaranteed-dead relay

@@ -487,3 +487,37 @@ def test_extreme_scales_roundtrip_through_kernel():
     out = np.asarray(q40.matmul(jnp.asarray(x), qt, impl="pallas_interpret"))
     np.testing.assert_allclose(out, ref, rtol=0,
                                atol=2e-2 * np.abs(ref).max() + 1e-12)
+
+
+def test_blocked_layout_probe_matches_stacked():
+    """The tile-contiguous layout probe (tools/sweep_q40.py
+    blocked_stacked_matmul) computes the SAME matmul as the production
+    row-major kernel — pinned in interpret mode so a hardware bandwidth
+    win measured by the probe is attributable to layout alone.  Ragged d
+    exercises the pad-to-td path (pad scales are zero → pad outputs 0)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "sweep_q40", os.path.join(os.path.dirname(__file__), "..",
+                                  "tools", "sweep_q40.py"))
+    sweep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sweep)
+
+    tn, td = 512, 128
+    L, n, d = 2, 1024, 320  # d ragged: 320 = 2*128 + 64
+    w = _rand((L, n, d), seed=11)
+    qt = q40.quantize(w)
+    x = _rand((1, n), seed=12, scale=1.0)
+    qb, sb, dp = sweep.block_pack(np.asarray(qt.qpacked),
+                                  np.asarray(qt.scales), tn, td)
+    assert dp == 384 and qb.shape == (L, n // tn, dp // td, tn // 2, td)
+    for layer in range(L):
+        ref = np.asarray(q40._pallas_matmul_stacked(
+            jnp.asarray(x), qt.qpacked, qt.scales, jnp.int32(layer),
+            interpret=True, variant="classic"))
+        out = np.asarray(sweep.blocked_stacked_matmul(
+            jnp.asarray(x), jnp.asarray(qb), jnp.asarray(sb),
+            jnp.int32(layer), tn, td, dp, interpret=True))
+        np.testing.assert_allclose(out[:, :d], ref, rtol=0, atol=1e-5)
+        assert np.all(out[:, d:] == 0.0)

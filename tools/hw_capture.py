@@ -290,7 +290,9 @@ def main() -> int:
     # --- 4./5. kernel probes ------------------------------------------
     done = sweep_done()
     probes = [("classic", tn, td, "w13") for tn, td in TILE_CONFIGS] + \
-             [(v, 1024, 1024, "w13,wo") for v in VARIANTS]
+             [(v, 1024, 1024, "w13,wo") for v in VARIANTS] + \
+             [("blocked", 1024, 1024, "w13,wo"),   # tile-contiguous layout
+              ("blocked", 512, 2048, "w13")]       # (PERF.md lever #1b)
     ran_probe = False
     for variant, tn, td, shapes in probes:
         if (variant, tn, td, tuple(sorted(shapes.split(",")))) in done:
@@ -319,14 +321,22 @@ def main() -> int:
         base_ms = w13.get(("classic", 1024, 1024))
         env = {}
         tags = []
-        if base_ms:
-            best = min(w13, key=w13.get)
+        # "blocked" rows are layout probes, not deployable via env — the
+        # lever promotion must pick the best DEPLOYABLE config or a fast
+        # blocked probe would silently starve the combined re-run
+        deployable = {k: v for k, v in w13.items() if k[0] != "blocked"}
+        if base_ms and deployable:
+            best = min(deployable, key=deployable.get)
             if best[0] == "classic" and best[1:] != (1024, 1024) \
                     and w13[best] < 0.95 * base_ms:
                 rule = json.dumps([[8192, best[1], best[2]]])
                 env["DLLAMA_Q40_TILES_JSON"] = rule
                 tags.append(f"tiles {rule}")
-            if best[0] != "classic" and w13[best] < 0.95 * base_ms:
+            if best[0] not in ("classic", "blocked") \
+                    and w13[best] < 0.95 * base_ms:
+                # "blocked" is a layout PROBE, not a deployable variant: a
+                # win there is the signal to graduate the tile-contiguous
+                # layout into the pack path, not an env flip
                 env["DLLAMA_Q40_VARIANT"] = best[0]
                 tags.append(f"variant {best[0]}")
         best_c = max((c for c in (64, 128)

@@ -52,12 +52,86 @@ CONFIGS = [
     # operand rework (q40.py _q40_kernel) — measure it on hardware
     ("exact", 1024, 1024),
     ("classic", 512, 2048), ("folded", 512, 2048), ("exact", 512, 2048),
+    # tile-contiguous layout probe (one sequential DMA per grid step; a
+    # wide-shape win here graduates the layout into the pack path)
+    ("blocked", 1024, 1024), ("blocked", 512, 2048),
     ("classic", 256, 4096), ("folded", 256, 4096),
     ("classic", 512, 4096),
     ("classic", 256, 2048),
     ("classic", 1024, 2048),
     ("classic", 512, 1024),
 ]
+
+
+def blocked_stacked_matmul(x, qp_blk, sc_blk, layer, tn, td, dp,
+                           interpret=False):
+    """Layer-indexed fused matmul over TILE-CONTIGUOUS packed storage.
+
+    The production layout streams a (tn/2, td) tile as tn/2 separate
+    td-byte bursts with a d-byte stride (ops/q40.py _pallas_matmul_stacked)
+    — measured r05 bandwidth falls to ~317 GB/s on w13 (d=22016) vs ~632
+    on narrow wo.  Here the packed plane is pre-blocked to
+    ``(L, n2/bn, dp/td, bn, td)`` so each grid step's DMA is ONE
+    fully-sequential ``bn·td``-byte read; if this probe reaches wo-class
+    bandwidth on wide shapes, the blocked layout graduates into the
+    production pack path (a load-time transform; docs/PERF.md lever #1b).
+    Same kernel, same math ('classic'), only the HBM layout differs —
+    ``d`` is padded to a td multiple (callers slice the (t, dp) output)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from dllama_tpu.ops import q40
+
+    t, n = x.shape
+    bn, bnb = tn // 2, tn // 32
+    grid = (dp // td, n // tn)
+    x_lo, x_hi = q40._x_parts(x.astype(jnp.bfloat16))
+    bsum = jnp.asarray(q40._bsum_mat(tn))
+    xspec = pl.BlockSpec((t, bn), lambda j, i, l: (0, i))
+    return pl.pallas_call(
+        functools.partial(q40._stacked_q40_kernel, nsteps=grid[1],
+                          variant="classic"),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                xspec,
+                xspec,
+                pl.BlockSpec(bsum.shape, lambda j, i, l: (0, 0)),
+                pl.BlockSpec((1, 1, 1, bn, td),
+                             lambda j, i, l: (l[0], i, j, 0, 0)),
+                pl.BlockSpec((1, 1, 1, bnb, td),
+                             lambda j, i, l: (l[0], i, j, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((t, td), lambda j, i, l: (0, j)),
+            scratch_shapes=[pltpu.VMEM((t, td), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((t, dp), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(layer.reshape(1).astype(jnp.int32), x_lo, x_hi, bsum, qp_blk, sc_blk)
+
+
+def block_pack(qp, sc, tn, td):
+    """Re-block row-major packed planes (L, n2, d) / (L, nb, d) into the
+    tile-contiguous layout blocked_stacked_matmul expects, padding d to a
+    td multiple (pad scales are zero → pad outputs are exactly 0)."""
+    import numpy as np
+
+    L, n2, d = qp.shape
+    bn, bnb = tn // 2, tn // 32
+    dp = -(-d // td) * td
+    qp_p = np.pad(np.asarray(qp), ((0, 0), (0, 0), (0, dp - d)))
+    sc_p = np.pad(np.asarray(sc), ((0, 0), (0, 0), (0, dp - d)))
+    qb = qp_p.reshape(L, n2 // bn, bn, dp // td, td).transpose(0, 1, 3, 2, 4)
+    sb = sc_p.reshape(L, sc_p.shape[1] // bnb, bnb, dp // td, td) \
+        .transpose(0, 1, 3, 2, 4)
+    return np.ascontiguousarray(qb), np.ascontiguousarray(sb), dp
 
 
 def measure_one(variant: str, reps: int = 32, only: set | None = None) -> dict:
@@ -83,9 +157,21 @@ def measure_one(variant: str, reps: int = 32, only: set | None = None) -> dict:
         if only and name not in only:
             continue
         nb = n // 32
-        qp = jnp.asarray(rng.randint(0, 256, (L, n // 2, d), dtype=np.uint8))
-        sc = jnp.asarray((rng.rand(L, nb, d).astype(np.float16) * 0.01).view(np.uint16))
         x = jnp.asarray(rng.randn(1, n).astype(np.float32), jnp.bfloat16)
+        tn, td = q40.TILE_N, q40.TILE_D
+        if variant == "blocked":
+            # tile-contiguous layout probe: bytes are bytes, so random
+            # blocked planes time identically to a real repack
+            dp = -(-d // td) * td
+            qp = jnp.asarray(rng.randint(
+                0, 256, (L, (n // 2) // (tn // 2), dp // td, tn // 2, td),
+                dtype=np.uint8))
+            sc = jnp.asarray(rng.randint(
+                0, 2 ** 14, (L, nb // (tn // 32), dp // td, tn // 32, td),
+                dtype=np.uint16))
+        else:
+            qp = jnp.asarray(rng.randint(0, 256, (L, n // 2, d), dtype=np.uint8))
+            sc = jnp.asarray((rng.rand(L, nb, d).astype(np.float16) * 0.01).view(np.uint16))
 
         # one compiled scan = `reps` serialized kernel calls cycling the
         # layer index (scalar-prefetch path), exactly like decode's layer
@@ -93,7 +179,11 @@ def measure_one(variant: str, reps: int = 32, only: set | None = None) -> dict:
         @jax.jit
         def run(x, qp, sc):
             def body(acc, i):
-                o = q40._pallas_matmul_stacked(x, qp, sc, i % L, variant=variant)
+                if variant == "blocked":
+                    o = blocked_stacked_matmul(x, qp, sc, i % L, tn, td, dp)
+                else:
+                    o = q40._pallas_matmul_stacked(x, qp, sc, i % L,
+                                                   variant=variant)
                 return acc + o.sum(), None
             return jax.lax.scan(body, jnp.float32(0), jnp.arange(reps))[0]
 
@@ -101,7 +191,8 @@ def measure_one(variant: str, reps: int = 32, only: set | None = None) -> dict:
         t0 = time.perf_counter()  # tunnel block_until_ready doesn't block)
         float(run(x, qp, sc))
         ms = (time.perf_counter() - t0) * 1000 / reps
-        nbytes = (n // 2) * d + nb * d * 2  # packed + f16-bit scales per layer
+        d_eff = dp if variant == "blocked" else d  # blocked pads d to td
+        nbytes = (n // 2) * d_eff + nb * d_eff * 2  # packed + f16-bit scales per layer
         gbps = nbytes / ms / 1e6
         out["shapes"][name] = {"ms": round(ms, 4), "GBps": round(gbps, 1)}
         total_ms += ms * L
