@@ -320,6 +320,13 @@ def _bench_decode(cfg, chunk=32, n_chunks=10, profile=False, start_pos=0,
     from dllama_tpu.runtime.decode_loop import decode_chunk
 
     params = _zero_q40_params(cfg)
+    if os.environ.get("DLLAMA_Q40_LAYOUT", "") == "blocked":
+        # tile-contiguous storage lever (ops/q40.py BlockedQTensor) — the
+        # capture's combined re-run flips this env when the blocked probe
+        # wins on raw bandwidth
+        from dllama_tpu.ops import q40 as _q40
+        params = _q40.blocked_params(params)
+        print("bench: blocked (tile-contiguous) Q40 layout", file=sys.stderr)
     cache = init_kv_cache(cfg, batch=batch, quant=kv_quant)
 
     fn = jax.jit(

@@ -305,6 +305,8 @@ def _f16_bits_to_f32(u: jax.Array) -> jax.Array:
 
 def dequantize(qt: QTensor, dtype=jnp.float32) -> jax.Array:
     """Reconstruct the dense array (tests / the XLA matmul path)."""
+    if isinstance(qt, BlockedQTensor):
+        qt = unblock(qt)
     *lead, n2, d = qt.qpacked.shape
     nb = n2 // 16
     v = qt.qpacked.astype(jnp.int32).reshape(*lead, nb, 16, d)
@@ -673,6 +675,160 @@ def _pad_x(x2: jax.Array, n: int, np_: int) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
+# Tile-contiguous ("blocked") storage — docs/PERF.md lever #1b
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class BlockedQTensor:
+    """Layer-stacked Q40 storage re-blocked so each kernel tile is ONE
+    fully-sequential HBM read.
+
+    The row-major layout streams a (tn/2, td) tile as tn/2 separate
+    td-byte bursts with a d-byte stride; the r05 xplane showed per-shape
+    kernel bandwidth falling with output width d (w13 at d=22016 ~317
+    GB/s vs wo at d=4096 ~632), pointing at burst length.  Here the
+    packed plane lives as ``(L, n2/bn, dp/td, bn, td)`` (``bn = tn/2``,
+    ``dp`` = d padded to a td multiple) so the tile DMA is ``bn·td``
+    contiguous bytes.  Scales are blocked the same way.  Created from a
+    row-major :class:`QTensor` at load time (:func:`to_blocked`, env
+    ``DLLAMA_Q40_LAYOUT=blocked``); single-device decode only — on a
+    multi-device mesh the loader keeps row-major storage, whose sharding
+    semantics match the reference's splitWeights (commands.cpp:19-36).
+    """
+
+    qpacked: jax.Array          # uint8  (L, n2/bn, dp/td, bn, td)
+    scales: jax.Array           # uint16 (L, n2/bn, dp/td, bn/16, td)
+    logical_nd: tuple[int, int] = field(metadata=dict(static=True))
+    tiles: tuple[int, int] = field(metadata=dict(static=True))  # (tn, td)
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return (self.qpacked.shape[0],) + self.logical_nd
+
+    @property
+    def dtype(self):
+        return jnp.bfloat16
+
+
+# default blocked tiles: tn=512 keeps bn·td at 512 KB per DMA with td=2048
+# (well under the VMEM cap; wide td = the long sequential burst being
+# probed).  Overridable until a hardware sweep bakes a measured choice.
+BLOCKED_TILES = tuple(
+    int(v) for v in os.environ.get("DLLAMA_Q40_BLOCK_TILES", "512,2048").split(","))
+
+
+def to_blocked(qt: QTensor, tn: int | None = None,
+               td: int | None = None) -> "BlockedQTensor":
+    """Re-block a layer-stacked row-major QTensor (qpacked (L, n2, d)).
+
+    d pads up to a td multiple with ZERO scales, so pad output columns are
+    exactly 0 and callers slice ``[..., :d]``.  One-time load-cost
+    transform (device-side reshape/transpose)."""
+    tn = tn or BLOCKED_TILES[0]
+    td = td or BLOCKED_TILES[1]
+    if qt.qpacked.ndim != 3:
+        raise ValueError("to_blocked expects a layer-stacked (L, n/2, d) "
+                         f"QTensor, got {qt.qpacked.shape}")
+    L, n2, d = qt.qpacked.shape
+    # clamp tiles to the tensor: tn falls down the divisor ladder (tiny
+    # test models; production shapes take the requested tn — note the
+    # hardware kernel needs tn ≥ 256 for the scales operand's sublane
+    # count, which every real model satisfies), td shrinks toward d so a
+    # narrow weight doesn't pad 20× (d pads to the next td multiple)
+    while tn > 32 and n2 % (tn // 2):
+        tn //= 2
+    td = min(td, -(-d // 128) * 128)
+    bn, bnb = tn // 2, tn // 32
+    if n2 % bn or tn % 32:
+        raise ValueError(f"packed rows {n2} not divisible by tn/2={bn}")
+    dp = -(-d // td) * td
+    qp = jnp.pad(qt.qpacked, ((0, 0), (0, 0), (0, dp - d)))
+    sc = jnp.pad(qt.scales, ((0, 0), (0, 0), (0, dp - d)))
+    qb = qp.reshape(L, n2 // bn, bn, dp // td, td).transpose(0, 1, 3, 2, 4)
+    sb = sc.reshape(L, n2 // bn, bnb, dp // td, td).transpose(0, 1, 3, 2, 4)
+    return BlockedQTensor(qb, sb, qt.logical_nd, (tn, td))
+
+
+def unblock(bqt: BlockedQTensor) -> QTensor:
+    """Inverse of :func:`to_blocked` (drops the d padding) — the XLA/CPU
+    dequant fallback path."""
+    L, nI, nJ, bn, td = bqt.qpacked.shape
+    d = bqt.logical_nd[1]
+    qp = bqt.qpacked.transpose(0, 1, 3, 2, 4).reshape(L, nI * bn, nJ * td)
+    bnb = bqt.scales.shape[3]
+    sc = bqt.scales.transpose(0, 1, 3, 2, 4).reshape(L, nI * bnb, nJ * td)
+    return QTensor(qp[..., :d], sc[..., :d], bqt.logical_nd)
+
+
+def _blocked_tiles_ok(bqt: "BlockedQTensor") -> bool:
+    """Hardware legality of a blocked tensor's pack-time tiles: the scales
+    operand needs tn/32 ≥ 8 sublanes (tn ≥ 256), td must be a lane-dim
+    multiple, and the packed block must respect the VMEM cap.  Failing
+    tiles degrade dispatch to the XLA path (tiny test shapes; bad env
+    overrides) instead of a Mosaic compile error mid-decode."""
+    tn, td = bqt.tiles
+    return tn >= 256 and tn % 32 == 0 and td % 128 == 0 \
+        and tn * td <= 4 * 1024 * 1024
+
+
+def blocked_params(params: dict) -> dict:
+    """Convert every layer-stacked dense Q40 weight in a params pytree to
+    the tile-contiguous layout (DLLAMA_Q40_LAYOUT=blocked).  2-D weights
+    (wcls — one matmul per step, not per layer) and 4-D MoE expert stacks
+    keep row-major storage."""
+    def conv(v):
+        if isinstance(v, QTensor) and v.qpacked.ndim == 3:
+            return to_blocked(v)
+        return v
+    return jax.tree.map(conv, params,
+                        is_leaf=lambda v: isinstance(v, QTensor))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pallas_matmul_blocked(x: jax.Array, qb: jax.Array, sb: jax.Array,
+                           layer: jax.Array,
+                           interpret: bool = False) -> jax.Array:
+    """Layer-indexed fused matmul over tile-contiguous packed storage.
+
+    Identical math to ``_pallas_matmul_stacked`` (classic variant); only
+    the HBM layout of the weight operands differs — each grid step DMAs
+    one contiguous (1,1,1,bn,td) block (the kernel's leading-singleton
+    squeeze handles the rank).  Returns (t, dp); callers slice ``[:, :d]``.
+    """
+    t = x.shape[0]
+    L, nI, nJ, bn, td = qb.shape
+    tn = bn * 2
+    grid = (nJ, nI)
+    x_lo, x_hi = _x_parts(x.astype(jnp.bfloat16))
+    bsum = jnp.asarray(_bsum_mat(tn))
+    xspec = pl.BlockSpec((t, bn), lambda j, i, l: (0, i))
+    return pl.pallas_call(
+        functools.partial(_stacked_q40_kernel, nsteps=grid[1],
+                          variant="classic"),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                xspec,
+                xspec,
+                pl.BlockSpec(bsum.shape, lambda j, i, l: (0, 0)),
+                pl.BlockSpec((1, 1, 1, bn, td),
+                             lambda j, i, l: (l[0], i, j, 0, 0)),
+                pl.BlockSpec((1, 1, 1, bn // 16, td),
+                             lambda j, i, l: (l[0], i, j, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((t, td), lambda j, i, l: (0, j)),
+            scratch_shapes=[pltpu.VMEM((t, td), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((t, nJ * td), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(layer.reshape(1).astype(jnp.int32), x_lo, x_hi, bsum, qb, sb)
+
+
+# ---------------------------------------------------------------------------
 # Tensor-parallel dispatch: per-shard pallas under shard_map
 # ---------------------------------------------------------------------------
 
@@ -885,11 +1041,48 @@ def matmul(x: jax.Array, qt: QTensor | QLayerView, impl: str = "auto",
     rows = int(np.prod(lead)) if lead else 1
     out_dtype = out_dtype or x.dtype
 
+    raw_qt = qt.qt if isinstance(qt, QLayerView) else qt
+    blocked = isinstance(raw_qt, BlockedQTensor)
+
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
-        np_probe = (qt.qt if isinstance(qt, QLayerView) else qt).qpacked.shape[-2] * 2
-        impl = "pallas" if (on_tpu and rows <= PALLAS_MAX_ROWS
-                            and _dispatch_tiles_ok(np_probe, d, rows, kind)) else "xla"
+        if blocked:
+            # blocked tiles are fixed at pack time; Mosaic-illegal tiles
+            # (clamped-down tn < 256 on tiny shapes, or a bad env
+            # override) degrade to the XLA path like the row-major ladder
+            impl = "pallas" if (on_tpu and rows <= PALLAS_MAX_ROWS
+                                and _blocked_tiles_ok(raw_qt)) else "xla"
+        else:
+            np_probe = raw_qt.qpacked.shape[-2] * 2
+            impl = "pallas" if (on_tpu and rows <= PALLAS_MAX_ROWS
+                                and _dispatch_tiles_ok(np_probe, d, rows, kind)) else "xla"
+
+    if blocked and impl == "pallas" and not _blocked_tiles_ok(raw_qt):
+        # forced-pallas callers (cfg.quant_impl) get the same degrade as
+        # auto dispatch — never a Mosaic compile error mid-decode
+        key = ("blocked", raw_qt.tiles)
+        if key not in _FALLBACK_WARNED:
+            _FALLBACK_WARNED.add(key)
+            print(f"⚠️  q40: blocked tiles {raw_qt.tiles} are not hardware-"
+                  "legal (need tn ≥ 256, td % 128 == 0); using the XLA "
+                  "dequant path for this weight")
+        impl = "xla"
+    if blocked and impl in ("pallas", "pallas_interpret"):
+        if _smap_mesh() is not None:
+            # blocked storage is single-device by construction (to_blocked
+            # is only applied on 1-device meshes); a mesh here means a
+            # programming error upstream
+            raise ValueError("BlockedQTensor cannot run under a multi-"
+                             "device mesh; load with row-major storage")
+        layer = qt.layer if isinstance(qt, QLayerView) else jnp.int32(0)
+        np_ = raw_qt.qpacked.shape[1] * raw_qt.tiles[0]
+        x2 = _pad_x(x.reshape(rows, n), n, np_)
+        out = _pallas_matmul_blocked(x2, raw_qt.qpacked, raw_qt.scales,
+                                     layer, interpret=impl == "pallas_interpret")
+        return out[:, :d].reshape(*lead, d).astype(out_dtype)
+    if blocked:  # xla / CPU fallback: undo the layout, then the dense path
+        un = unblock(raw_qt)
+        qt = QLayerView(un, qt.layer) if isinstance(qt, QLayerView) else un
 
     if impl in ("pallas", "pallas_interpret"):
         interp = impl == "pallas_interpret"
@@ -950,7 +1143,7 @@ def mm(x: jax.Array, w, impl: str = "auto", out_dtype=None,
         base = w.qt if isinstance(w, QLayerView) else w
         if isinstance(base, q8.Q8Tensor):
             return q8.matmul(x, w, impl=impl, out_dtype=out_dtype, kind=kind)
-        if isinstance(base, QTensor):
+        if isinstance(base, (QTensor, BlockedQTensor)):
             return matmul(x, w, impl=impl, out_dtype=out_dtype, kind=kind)
         raise TypeError(f"mm: unsupported weight type {type(w).__name__}")
     out = x @ w

@@ -21,6 +21,7 @@ only remaining boundary: fetching logits for the host-side sampler.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 
@@ -173,6 +174,15 @@ class Engine:
                 raise ValueError(
                     f"n_experts {cfg.n_experts} not divisible by ep={ep}")
         self.cfg = cfg
+        if os.environ.get("DLLAMA_Q40_LAYOUT", "") == "blocked" \
+                and self.mesh.size == 1:
+            # tile-contiguous packed storage (ops/q40.py BlockedQTensor):
+            # every layer-stacked dense Q40 weight's kernel tile becomes
+            # one sequential HBM read — single-device decode only; on a
+            # mesh the row-major layout keeps its splitWeights-compatible
+            # sharding semantics
+            from ..ops import q40
+            params = q40.blocked_params(params)
         self.params = sharding.place_params(params, cfg, self.mesh)
         # kv_dtype "q8" (or int8) selects the quantized cache: int8 values
         # + per-position f32 scales — ~2× less cache HBM traffic and

@@ -321,24 +321,22 @@ def main() -> int:
         base_ms = w13.get(("classic", 1024, 1024))
         env = {}
         tags = []
-        # "blocked" rows are layout probes, not deployable via env — the
-        # lever promotion must pick the best DEPLOYABLE config or a fast
-        # blocked probe would silently starve the combined re-run
-        deployable = {k: v for k, v in w13.items() if k[0] != "blocked"}
-        if base_ms and deployable:
-            best = min(deployable, key=deployable.get)
-            if best[0] == "classic" and best[1:] != (1024, 1024) \
-                    and w13[best] < 0.95 * base_ms:
-                rule = json.dumps([[8192, best[1], best[2]]])
-                env["DLLAMA_Q40_TILES_JSON"] = rule
-                tags.append(f"tiles {rule}")
-            if best[0] not in ("classic", "blocked") \
-                    and w13[best] < 0.95 * base_ms:
-                # "blocked" is a layout PROBE, not a deployable variant: a
-                # win there is the signal to graduate the tile-contiguous
-                # layout into the pack path, not an env flip
-                env["DLLAMA_Q40_VARIANT"] = best[0]
-                tags.append(f"variant {best[0]}")
+        if base_ms:
+            best = min(w13, key=w13.get)
+            if w13[best] < 0.95 * base_ms:
+                if best[0] == "blocked":
+                    # the tile-contiguous layout is deployable end to end
+                    # (ops/q40.py BlockedQTensor, DLLAMA_Q40_LAYOUT)
+                    env["DLLAMA_Q40_LAYOUT"] = "blocked"
+                    env["DLLAMA_Q40_BLOCK_TILES"] = f"{best[1]},{best[2]}"
+                    tags.append(f"blocked tiles {best[1]},{best[2]}")
+                elif best[0] == "classic" and best[1:] != (1024, 1024):
+                    rule = json.dumps([[8192, best[1], best[2]]])
+                    env["DLLAMA_Q40_TILES_JSON"] = rule
+                    tags.append(f"tiles {rule}")
+                elif best[0] != "classic":
+                    env["DLLAMA_Q40_VARIANT"] = best[0]
+                    tags.append(f"variant {best[0]}")
         best_c = max((c for c in (64, 128)
                       if extras.get(f"llama2-7b_c{c}_toks", 0) > baseline_toks),
                      key=lambda c: extras[f"llama2-7b_c{c}_toks"], default=None)
@@ -351,7 +349,9 @@ def main() -> int:
                     out["metric"] += " [" + ", ".join(tags) + "]"
                     extras.setdefault("llama2-7b_default_toks", baseline_toks)
                     for t in tags:
-                        if t.startswith("tiles"):
+                        if t.startswith("blocked"):
+                            extras["blocked_tiles"] = env["DLLAMA_Q40_BLOCK_TILES"]
+                        elif t.startswith("tiles"):
                             extras["tile_rule"] = env["DLLAMA_Q40_TILES_JSON"]
                         else:
                             extras["kernel_variant"] = env["DLLAMA_Q40_VARIANT"]

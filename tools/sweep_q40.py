@@ -65,73 +65,28 @@ CONFIGS = [
 
 def blocked_stacked_matmul(x, qp_blk, sc_blk, layer, tn, td, dp,
                            interpret=False):
-    """Layer-indexed fused matmul over TILE-CONTIGUOUS packed storage.
-
-    The production layout streams a (tn/2, td) tile as tn/2 separate
-    td-byte bursts with a d-byte stride (ops/q40.py _pallas_matmul_stacked)
-    — measured r05 bandwidth falls to ~317 GB/s on w13 (d=22016) vs ~632
-    on narrow wo.  Here the packed plane is pre-blocked to
-    ``(L, n2/bn, dp/td, bn, td)`` so each grid step's DMA is ONE
-    fully-sequential ``bn·td``-byte read; if this probe reaches wo-class
-    bandwidth on wide shapes, the blocked layout graduates into the
-    production pack path (a load-time transform; docs/PERF.md lever #1b).
-    Same kernel, same math ('classic'), only the HBM layout differs —
-    ``d`` is padded to a td multiple (callers slice the (t, dp) output)."""
-    import functools
-
-    import jax
-    import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
+    """Layer-indexed fused matmul over TILE-CONTIGUOUS packed storage —
+    thin wrapper over the production kernel (ops/q40.py
+    _pallas_matmul_blocked / BlockedQTensor, docs/PERF.md lever #1b); the
+    probe and the deployed path are the same code by construction."""
     from dllama_tpu.ops import q40
-
-    t, n = x.shape
-    bn, bnb = tn // 2, tn // 32
-    grid = (dp // td, n // tn)
-    x_lo, x_hi = q40._x_parts(x.astype(jnp.bfloat16))
-    bsum = jnp.asarray(q40._bsum_mat(tn))
-    xspec = pl.BlockSpec((t, bn), lambda j, i, l: (0, i))
-    return pl.pallas_call(
-        functools.partial(q40._stacked_q40_kernel, nsteps=grid[1],
-                          variant="classic"),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=grid,
-            in_specs=[
-                xspec,
-                xspec,
-                pl.BlockSpec(bsum.shape, lambda j, i, l: (0, 0)),
-                pl.BlockSpec((1, 1, 1, bn, td),
-                             lambda j, i, l: (l[0], i, j, 0, 0)),
-                pl.BlockSpec((1, 1, 1, bnb, td),
-                             lambda j, i, l: (l[0], i, j, 0, 0)),
-            ],
-            out_specs=pl.BlockSpec((t, td), lambda j, i, l: (0, j)),
-            scratch_shapes=[pltpu.VMEM((t, td), jnp.float32)],
-        ),
-        out_shape=jax.ShapeDtypeStruct((t, dp), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
-        interpret=interpret,
-    )(layer.reshape(1).astype(jnp.int32), x_lo, x_hi, bsum, qp_blk, sc_blk)
+    del tn, td, dp  # implied by the blocked plane shapes
+    return q40._pallas_matmul_blocked(x, qp_blk, sc_blk, layer,
+                                      interpret=interpret)
 
 
 def block_pack(qp, sc, tn, td):
     """Re-block row-major packed planes (L, n2, d) / (L, nb, d) into the
-    tile-contiguous layout blocked_stacked_matmul expects, padding d to a
-    td multiple (pad scales are zero → pad outputs are exactly 0)."""
+    tile-contiguous layout (production transform: q40.to_blocked).
+    Returns host numpy arrays + the padded width dp."""
     import numpy as np
 
-    L, n2, d = qp.shape
-    bn, bnb = tn // 2, tn // 32
-    dp = -(-d // td) * td
-    qp_p = np.pad(np.asarray(qp), ((0, 0), (0, 0), (0, dp - d)))
-    sc_p = np.pad(np.asarray(sc), ((0, 0), (0, 0), (0, dp - d)))
-    qb = qp_p.reshape(L, n2 // bn, bn, dp // td, td).transpose(0, 1, 3, 2, 4)
-    sb = sc_p.reshape(L, sc_p.shape[1] // bnb, bnb, dp // td, td) \
-        .transpose(0, 1, 3, 2, 4)
-    return np.ascontiguousarray(qb), np.ascontiguousarray(sb), dp
+    from dllama_tpu.ops import q40
+
+    bqt = q40.to_blocked(
+        q40.QTensor(qp, sc, (qp.shape[1] * 2, qp.shape[2])), tn, td)
+    return (np.asarray(bqt.qpacked), np.asarray(bqt.scales),
+            bqt.qpacked.shape[2] * bqt.tiles[1])  # to_blocked may clamp td
 
 
 def measure_one(variant: str, reps: int = 32, only: set | None = None) -> dict:
