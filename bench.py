@@ -295,6 +295,23 @@ def _pallas_hw_check():
         err = float(np.max(np.abs(out_p - out_x)) / (np.max(np.abs(out_x)) + 1e-9))
         if err > 2e-2:
             raise AssertionError(f"pallas/xla mismatch, rel err {err:.3g}")
+        if os.environ.get("DLLAMA_Q40_LAYOUT", "") == "blocked":
+            # probe the blocked kernel's Mosaic lowering too: the static
+            # tile predicate (_blocked_tiles_ok) cannot prove lowerability
+            # at real shapes, and a compile failure must downgrade the run
+            # here — not crash the first decode step
+            import jax.numpy as jnp2
+            w3 = (rng.randn(2, 2048, 512) * 0.1).astype(np.float32)
+            bqt = q40.to_blocked(q40.quantize(w3))
+            view = q40.QLayerView(bqt, jnp2.int32(1))
+            out_b = np.asarray(q40.matmul(x, view, impl="pallas"))
+            ref_b = np.asarray(q40.matmul(x, view, impl="xla"))
+            err_b = float(np.max(np.abs(out_b - ref_b))
+                          / (np.max(np.abs(ref_b)) + 1e-9))
+            if err_b > 2e-2:
+                raise AssertionError(f"blocked mismatch, rel err {err_b:.3g}")
+            print(f"pallas hardware check: blocked layout OK "
+                  f"(max rel err {err_b:.2e})", file=sys.stderr)
         print(f"pallas hardware check: OK (max rel err {err:.2e})", file=sys.stderr)
         return "pallas"
     except Exception as e:

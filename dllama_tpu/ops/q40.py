@@ -698,12 +698,18 @@ class BlockedQTensor:
     """
 
     qpacked: jax.Array          # uint8  (L, n2/bn, dp/td, bn, td)
-    scales: jax.Array           # uint16 (L, n2/bn, dp/td, bn/16, td)
+    scales: jax.Array          # uint16 (L, n2/bn, dp/td, bn/16, td)
     logical_nd: tuple[int, int] = field(metadata=dict(static=True))
     tiles: tuple[int, int] = field(metadata=dict(static=True))  # (tn, td)
+    # True when built from a 2-D (n/2, d) tensor (wcls — the widest d and
+    # the worst strided-burst penalty): storage carries L=1 and unblock
+    # squeezes it back out
+    lead_2d: bool = field(default=False, metadata=dict(static=True))
 
     @property
     def shape(self) -> tuple[int, ...]:
+        if self.lead_2d:
+            return self.logical_nd
         return (self.qpacked.shape[0],) + self.logical_nd
 
     @property
@@ -727,10 +733,13 @@ def to_blocked(qt: QTensor, tn: int | None = None,
     transform (device-side reshape/transpose)."""
     tn = tn or BLOCKED_TILES[0]
     td = td or BLOCKED_TILES[1]
-    if qt.qpacked.ndim != 3:
-        raise ValueError("to_blocked expects a layer-stacked (L, n/2, d) "
-                         f"QTensor, got {qt.qpacked.shape}")
-    L, n2, d = qt.qpacked.shape
+    lead_2d = qt.qpacked.ndim == 2
+    qp0 = qt.qpacked[None] if lead_2d else qt.qpacked
+    sc0 = qt.scales[None] if lead_2d else qt.scales
+    if qp0.ndim != 3:
+        raise ValueError("to_blocked expects a (n/2, d) or layer-stacked "
+                         f"(L, n/2, d) QTensor, got {qt.qpacked.shape}")
+    L, n2, d = qp0.shape
     # clamp tiles to the tensor: tn falls down the divisor ladder (tiny
     # test models; production shapes take the requested tn — note the
     # hardware kernel needs tn ≥ 256 for the scales operand's sublane
@@ -743,11 +752,11 @@ def to_blocked(qt: QTensor, tn: int | None = None,
     if n2 % bn or tn % 32:
         raise ValueError(f"packed rows {n2} not divisible by tn/2={bn}")
     dp = -(-d // td) * td
-    qp = jnp.pad(qt.qpacked, ((0, 0), (0, 0), (0, dp - d)))
-    sc = jnp.pad(qt.scales, ((0, 0), (0, 0), (0, dp - d)))
+    qp = jnp.pad(qp0, ((0, 0), (0, 0), (0, dp - d)))
+    sc = jnp.pad(sc0, ((0, 0), (0, 0), (0, dp - d)))
     qb = qp.reshape(L, n2 // bn, bn, dp // td, td).transpose(0, 1, 3, 2, 4)
     sb = sc.reshape(L, n2 // bn, bnb, dp // td, td).transpose(0, 1, 3, 2, 4)
-    return BlockedQTensor(qb, sb, qt.logical_nd, (tn, td))
+    return BlockedQTensor(qb, sb, qt.logical_nd, (tn, td), lead_2d)
 
 
 def unblock(bqt: BlockedQTensor) -> QTensor:
@@ -758,27 +767,46 @@ def unblock(bqt: BlockedQTensor) -> QTensor:
     qp = bqt.qpacked.transpose(0, 1, 3, 2, 4).reshape(L, nI * bn, nJ * td)
     bnb = bqt.scales.shape[3]
     sc = bqt.scales.transpose(0, 1, 3, 2, 4).reshape(L, nI * bnb, nJ * td)
+    if bqt.lead_2d:
+        qp, sc = qp[0], sc[0]
     return QTensor(qp[..., :d], sc[..., :d], bqt.logical_nd)
 
 
+def _unblock_layer(bqt: "BlockedQTensor", layer: jax.Array) -> QTensor:
+    """Un-transpose ONE layer of a blocked stack to row-major (the XLA
+    fallback for per-layer calls — prefill rows past PALLAS_MAX_ROWS)."""
+    qp = jax.lax.dynamic_index_in_dim(bqt.qpacked, layer, 0, keepdims=False)
+    sc = jax.lax.dynamic_index_in_dim(bqt.scales, layer, 0, keepdims=False)
+    nI, nJ, bn, td = qp.shape
+    d = bqt.logical_nd[1]
+    qp = qp.transpose(0, 2, 1, 3).reshape(nI * bn, nJ * td)[:, :d]
+    bnb = sc.shape[2]
+    sc = sc.transpose(0, 2, 1, 3).reshape(nI * bnb, nJ * td)[:, :d]
+    return QTensor(qp, sc, bqt.logical_nd)
+
+
 def _blocked_tiles_ok(bqt: "BlockedQTensor") -> bool:
-    """Hardware legality of a blocked tensor's pack-time tiles: the scales
+    """STATIC legality of a blocked tensor's pack-time tiles: the scales
     operand needs tn/32 ≥ 8 sublanes (tn ≥ 256), td must be a lane-dim
     multiple, and the packed block must respect the VMEM cap.  Failing
     tiles degrade dispatch to the XLA path (tiny test shapes; bad env
-    overrides) instead of a Mosaic compile error mid-decode."""
+    overrides).  This predicate cannot prove Mosaic lowerability at real
+    shapes — the bench's hardware check compiles the blocked kernel once
+    before trusting it (bench.py _pallas_hw_check), which is where a
+    genuine lowering failure downgrades the run."""
     tn, td = bqt.tiles
     return tn >= 256 and tn % 32 == 0 and td % 128 == 0 \
         and tn * td <= 4 * 1024 * 1024
 
 
 def blocked_params(params: dict) -> dict:
-    """Convert every layer-stacked dense Q40 weight in a params pytree to
-    the tile-contiguous layout (DLLAMA_Q40_LAYOUT=blocked).  2-D weights
-    (wcls — one matmul per step, not per layer) and 4-D MoE expert stacks
-    keep row-major storage."""
+    """Convert every dense Q40 weight in a params pytree to the
+    tile-contiguous layout (DLLAMA_Q40_LAYOUT=blocked): layer-stacked
+    3-D weights and 2-D wcls (the widest d — the worst strided-burst
+    penalty).  4-D MoE expert stacks keep row-major storage (the
+    expert-select kernel path, _sharded_matmul_ep)."""
     def conv(v):
-        if isinstance(v, QTensor) and v.qpacked.ndim == 3:
+        if isinstance(v, QTensor) and v.qpacked.ndim in (2, 3):
             return to_blocked(v)
         return v
     return jax.tree.map(conv, params,
@@ -1081,8 +1109,13 @@ def matmul(x: jax.Array, qt: QTensor | QLayerView, impl: str = "auto",
                                      layer, interpret=impl == "pallas_interpret")
         return out[:, :d].reshape(*lead, d).astype(out_dtype)
     if blocked:  # xla / CPU fallback: undo the layout, then the dense path
-        un = unblock(raw_qt)
-        qt = QLayerView(un, qt.layer) if isinstance(qt, QLayerView) else un
+        if isinstance(qt, QLayerView):
+            # slice the ONE layer first, then un-transpose it: unblocking
+            # the whole (L, ...) stack inside a traced per-layer call
+            # would relayout every layer's bytes L times per forward
+            qt = _unblock_layer(raw_qt, qt.layer)
+        else:
+            qt = unblock(raw_qt)
 
     if impl in ("pallas", "pallas_interpret"):
         interp = impl == "pallas_interpret"

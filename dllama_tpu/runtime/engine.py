@@ -174,15 +174,20 @@ class Engine:
                 raise ValueError(
                     f"n_experts {cfg.n_experts} not divisible by ep={ep}")
         self.cfg = cfg
-        if os.environ.get("DLLAMA_Q40_LAYOUT", "") == "blocked" \
-                and self.mesh.size == 1:
-            # tile-contiguous packed storage (ops/q40.py BlockedQTensor):
-            # every layer-stacked dense Q40 weight's kernel tile becomes
-            # one sequential HBM read — single-device decode only; on a
-            # mesh the row-major layout keeps its splitWeights-compatible
-            # sharding semantics
-            from ..ops import q40
-            params = q40.blocked_params(params)
+        if os.environ.get("DLLAMA_Q40_LAYOUT", "") == "blocked":
+            if self.mesh.size == 1:
+                # tile-contiguous packed storage (ops/q40.py
+                # BlockedQTensor): every dense Q40 weight's kernel tile
+                # becomes one sequential HBM read — single-device decode
+                # only; on a mesh the row-major layout keeps its
+                # splitWeights-compatible sharding semantics
+                from ..ops import q40
+                params = q40.blocked_params(params)
+            else:
+                import sys
+                print("⚠️  DLLAMA_Q40_LAYOUT=blocked ignored: blocked "
+                      "storage is single-device only (mesh size "
+                      f"{self.mesh.size} keeps row-major)", file=sys.stderr)
         self.params = sharding.place_params(params, cfg, self.mesh)
         # kv_dtype "q8" (or int8) selects the quantized cache: int8 values
         # + per-position f32 scales — ~2× less cache HBM traffic and
