@@ -143,8 +143,10 @@ def _our_generate(mpath: str, tpath: str, prompt: str, steps: int) -> str:
     return r.stdout.splitlines()[-1]
 
 
-@pytest.mark.parametrize("ftype", [quants.F32, quants.Q40, quants.Q80],
-                         ids=["f32-weights", "q40-weights", "q80-weights"])
+@pytest.mark.parametrize("ftype", [quants.F32, quants.F16, quants.Q40,
+                                   quants.Q80],
+                         ids=["f32-weights", "f16-weights", "q40-weights",
+                              "q80-weights"])
 def test_generate_stream_matches_reference_binary(tmp_path, ftype):
     exe = _ref_binary()
     mpath, tpath = str(tmp_path / "toy.m"), str(tmp_path / "toy.t")
