@@ -56,6 +56,7 @@ import time
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1500"))
 PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
 BASELINE_7B_TOKS = 9.82  # README.md:126 — 101.81 ms/token, 1× c3d-highcpu-30
+BASELINE_13B_TOKS = 5.43  # README.md:127 — 184.19 ms/token, 1× c3d-highcpu-30
 # the axon relay's remote-compile HTTP endpoint; when this port is not even
 # listening, the PJRT claim inside jax.devices() blocks forever (observed
 # r03) — so the TCP check below is the cheap gate in front of every probe
@@ -100,6 +101,14 @@ def _model_cfg(name):
         return tiny_config(dim=4096, hidden_dim=14336, n_layers=32, n_heads=32,
                            n_kv_heads=8, vocab_size=128256, seq_len=2048,
                            rope_theta=500000.0, dtype=jnp.bfloat16)
+    if name == "llama2-13b":
+        # README.md:127 row (184.19 ms/token on the reference's best VM);
+        # 13B Q40 packs to ~7.3 GB — fits one v5e chip's 16 GB HBM next
+        # to its bf16 cache, so the reference's 13B row gets a same-chip
+        # comparison too
+        return tiny_config(dim=5120, hidden_dim=13824, n_layers=40, n_heads=40,
+                           n_kv_heads=40, vocab_size=32000, seq_len=1024,
+                           dtype=jnp.bfloat16)
     if name == "tinyllama-1.1b":  # launch.py:7
         return tiny_config(dim=2048, hidden_dim=5632, n_layers=22, n_heads=32,
                            n_kv_heads=4, vocab_size=32000, seq_len=2048,
@@ -379,6 +388,32 @@ def _bench_decode(cfg, chunk=32, n_chunks=10, profile=False, start_pos=0,
     return float(np.mean(times))
 
 
+def _bench_prefill(cfg, T=512, reps=6):
+    """Avg ms/token over ``reps`` bucketed prefill forwards (compile +
+    warmup excluded).  The cache is NOT donated — each rep rewrites the
+    same pos-0 window, and the extra cache copy is noise next to the
+    T-token matmul volume."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from dllama_tpu.models.transformer import forward_last, init_kv_cache
+
+    params = _zero_q40_params(cfg)
+    cache = init_kv_cache(cfg, batch=1)
+    fn = jax.jit(lambda p, c, t: forward_last(p, cfg, t, c, jnp.int32(0),
+                                              jnp.int32(T - 1)))
+    toks = jnp.zeros((1, T), jnp.int32)
+    t0 = time.perf_counter()
+    logits, _ = fn(params, cache, toks)
+    np.asarray(logits)
+    print(f"compile+warmup: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        logits, _ = fn(params, cache, toks)
+        np.asarray(logits)
+    return (time.perf_counter() - t0) * 1000 / reps / T
+
+
 def run_attempt(name):
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import jax
@@ -397,6 +432,19 @@ def run_attempt(name):
             "value": round(1000.0 / ms, 2), "unit": "tok/s",
             "vs_baseline": round(1000.0 / ms / BASELINE_7B_TOKS, 2),
             "backend": jax.default_backend()}))
+        return
+
+    if name == "llama2-7b-prefill":
+        # prompt-evaluation throughput (the reference's "evaluation" stat,
+        # dllama.cpp:45-93; no published number to compare): one bucketed
+        # forward over T tokens through the REAL dispatch (quant_impl
+        # "auto": prefill rows beyond PALLAS_MAX_ROWS take the XLA dequant
+        # path, which pipelines the unpack into the MXU dots)
+        ms = _bench_prefill(_model_cfg("llama2-7b"))
+        print(json.dumps({
+            "metric": "llama2-7b q40 prefill tok/s (1 TPU chip, T=512)",
+            "value": round(1000.0 / ms, 1), "unit": "tok/s",
+            "vs_baseline": None, "backend": jax.default_backend()}))
         return
 
     batch = 1
@@ -486,6 +534,9 @@ def run_attempt(name):
         if chunk_override:
             metric += f" [chunk={chunk}]"
         vs = round(toks / BASELINE_7B_TOKS, 2)
+    elif name == "llama2-13b":
+        metric = f"llama2-13b q40 greedy decode tok/s (1 TPU chip, {impl})"
+        vs = round(toks / BASELINE_13B_TOKS, 2)
     elif name == "tinyllama-1.1b":
         metric = f"tinyllama-1.1b q40 greedy decode tok/s (1 TPU chip, {impl})"
         vs = None  # no published reference number for this config
@@ -875,6 +926,18 @@ def main():
                 extras["llama2-7b_16k_q8kv_toks"] = q8kv_out["value"]
                 print(f"bench: int8-KV long-context: {json.dumps(q8kv_out)}",
                       file=sys.stderr)
+        # prompt-evaluation throughput + the reference's 13B row — cheap
+        # extras once the headline is in hand
+        if got_7b and remaining() > RESERVE + 200 and _relay_up():
+            pf_out = _spawn("llama2-7b-prefill",
+                            min(remaining() - RESERVE - 60, 240))
+            if pf_out:
+                extras["llama2-7b_prefill_toks"] = pf_out["value"]
+        if got_7b and remaining() > RESERVE + 400 and _relay_up():
+            out13 = _spawn("llama2-13b", min(remaining() - RESERVE - 60, 600))
+            if out13:
+                extras["llama2-13b_toks"] = out13["value"]
+                print(f"bench: 13B row: {json.dumps(out13)}", file=sys.stderr)
         # xplane I/T-split diagnostics run DEAD LAST: the r05 window showed
         # the tunnel profiler can wedge the chip's exclusive claim, hanging
         # every subsequent client — after this stage there is nothing left

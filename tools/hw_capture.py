@@ -362,16 +362,23 @@ def main() -> int:
                 return 4
 
     # --- 7. extras ----------------------------------------------------
-    for name, key, msg in (
-            ("llama2-7b-b8", "llama2-7b_batch8_agg_toks", "batch=8 aggregate"),
-            ("llama2-7b-long", "llama2-7b_16k_toks", "16k long-context"),
+    for name, key, msg, stage_timeout in (
+            ("llama2-7b-b8", "llama2-7b_batch8_agg_toks",
+             "batch=8 aggregate", 360),
+            ("llama2-7b-long", "llama2-7b_16k_toks", "16k long-context", 360),
             ("llama2-7b-long-q8kv", "llama2-7b_16k_q8kv_toks",
-             "int8-KV 16k long-context")):
+             "int8-KV 16k long-context", 360),
+            ("llama2-7b-prefill", "llama2-7b_prefill_toks",
+             "prefill throughput", 300),
+            # 13B compiles every 40-layer kernel shape fresh over the
+            # tunnel — give it the same headroom bench.py budgets (600+)
+            ("llama2-13b", "llama2-13b_toks", "13B decode (reference row "
+             "README.md:127)", 900)):
         if key in extras:
             continue
         if not relay_up():
             return 6  # stages remain; watcher keeps the fast 60 s poll
-        out = attempt(name, 360)
+        out = attempt(name, stage_timeout)
         if out:
             extras[key] = out["value"]
             merge_commit(f"In-session TPU capture: {msg}")
