@@ -420,46 +420,86 @@ class Engine:
         if token in eos_ids:
             return
 
-        while produced < steps and self.pos < self.seq_len:
-            k = min(chunk, steps - produced, self.seq_len - self.pos)
+        # Pipelined chunk dispatch: chunk N+1 is enqueued — fed the
+        # on-device last token the chunk fn returns — BEFORE chunk N's ids
+        # are fetched, so the host dispatch/RPC bubble (measured ~2.3
+        # ms/token over the axon tunnel at chunk 32, docs/PERF.md)
+        # overlaps device execution.  Token streams are bit-identical to
+        # the serial schedule (same compiled fn, same inputs; only host
+        # scheduling changes).  An EOS that lands mid-chunk discards the
+        # one speculative in-flight chunk: its cache writes sit past the
+        # rewound position (dead rows, overwritten later, same overshoot
+        # invariant as within-chunk EOS) and its RNG tick is rolled back.
+        def dispatch(in_tok_dev, done):
+            # ``done`` counts tokens EXPECTED by prior dispatches (not yet
+            # necessarily fetched) so a speculative chunk never overshoots
+            # the requested steps
+            k = min(chunk, steps - done, self.seq_len - self.pos)
             fn = self._chunk_fn(k, temperature, topp)
             sub = jax.random.fold_in(self._key, self._chunk_counter)
             self._chunk_counter += 1
             p0 = self.pos
             t0 = time.perf_counter()
             with active_mesh(self.mesh):
-                toks_dev, self.cache, _last, _pos, _key = fn(
-                    self.params, self.cache,
-                    jnp.full((self.batch,), token, jnp.int32), jnp.int32(p0), sub)
-            jax.block_until_ready(toks_dev)
-            t1 = time.perf_counter()
-            toks = np.asarray(toks_dev)[:, 0]  # (k,)
-            t2 = time.perf_counter()
+                toks_dev, self.cache, last_dev, _pos, _key = fn(
+                    self.params, self.cache, in_tok_dev, jnp.int32(p0), sub)
             self.pos = p0 + k
-            if self.timing_mode == "host-fetch":
-                i_ms, t_ms = (t2 - t0) * 1000 / k, 0.0  # see __init__
-            else:
-                i_ms, t_ms = (t1 - t0) * 1000 / k, (t2 - t1) * 1000 / k
-            # chunk averages: each of the k tokens carries 1/k of the
-            # chunk's wall/device/boundary cost (labeled as such in the CLI)
-            per = StepStats(
-                generation_ms=(t2 - t0) * 1000 / k,
-                inference_ms=i_ms,
-                transfer_ms=t_ms,
-                sent_bytes=(self.batch * 4 + 8) / k,
-                recv_bytes=toks.nbytes / k)
-            for j, tk in enumerate(toks.tolist()):
-                token = int(tk)
-                yield token, per
-                produced += 1
-                if token in eos_ids:
-                    # rewind past the unconsumed overshoot so a following
-                    # turn prefills at the right position (masked rows are
-                    # never attended and get overwritten)
-                    self.pos = p0 + j + 1
-                    return
-                if produced >= steps:
-                    return
+            return k, p0, toks_dev, last_dev, t0
+
+        if produced >= steps or self.pos >= self.seq_len:
+            return  # nothing left to dispatch (e.g. max_tokens == 1)
+        pending = dispatch(jnp.full((self.batch,), token, jnp.int32), produced)
+        expected = produced
+        boundary = None
+        try:
+            while pending is not None:
+                k, p0, toks_dev, last_dev, t0 = pending
+                expected += k
+                pending = dispatch(last_dev, expected) \
+                    if expected < steps and self.pos < self.seq_len else None
+                jax.block_until_ready(toks_dev)
+                t1 = time.perf_counter()
+                toks = np.asarray(toks_dev)[:, 0]  # (k,)
+                t2 = time.perf_counter()
+                # steady-state chunk wall = boundary to boundary (this
+                # chunk was dispatched before the PREVIOUS fetch returned)
+                g0 = t0 if boundary is None else max(boundary, t0)
+                boundary = t2
+                if self.timing_mode == "host-fetch":
+                    i_ms, t_ms = (t2 - g0) * 1000 / k, 0.0  # see __init__
+                else:
+                    i_ms, t_ms = (t1 - g0) * 1000 / k, (t2 - t1) * 1000 / k
+                # chunk averages: each of the k tokens carries 1/k of the
+                # chunk's wall/device/boundary cost (labeled in the CLI)
+                per = StepStats(
+                    generation_ms=(t2 - g0) * 1000 / k,
+                    inference_ms=i_ms,
+                    transfer_ms=t_ms,
+                    sent_bytes=(self.batch * 4 + 8) / k,
+                    recv_bytes=toks.nbytes / k)
+                for j, tk in enumerate(toks.tolist()):
+                    token = int(tk)
+                    yield token, per
+                    produced += 1
+                    if token in eos_ids:
+                        # rewind past the unconsumed overshoot so a
+                        # following turn prefills at the right position
+                        # (masked rows are never attended and get
+                        # overwritten); the finally below returns the
+                        # speculative chunk's RNG tick
+                        self.pos = p0 + j + 1
+                        return
+                    if produced >= steps:
+                        return
+        finally:
+            # Reached on EOS return AND when the consumer abandons the
+            # generator (stop-string break in drain_generation →
+            # GeneratorExit): a speculative in-flight chunk is dead rows
+            # past the live position, and its unconsumed RNG tick is
+            # returned so a later turn's sampled stream is
+            # schedule-independent of the pipelining.
+            if pending is not None:
+                self._chunk_counter -= 1
 
     def generate_batch(self, prompts: list[list[int]], steps: int, *,
                        temperature: float = 0.0, topp: float = 0.9,

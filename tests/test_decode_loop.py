@@ -142,3 +142,48 @@ def test_batched_decode_rows_independent():
     solo_b = run([11])
     np.testing.assert_array_equal(batched[:, 0], solo_a[:, 0])
     np.testing.assert_array_equal(batched[:, 1], solo_b[:, 0])
+
+
+def test_pipelined_eos_rolls_back_speculative_rng_tick():
+    """The pipelined chunk dispatch (engine.generate_stream) enqueues one
+    speculative chunk ahead; a mid-chunk EOS must return that chunk's
+    unconsumed RNG tick so the per-session sampler stream is
+    schedule-independent — the counter afterwards equals what a serial
+    schedule would have consumed (one tick for the first post-prefill
+    sample + one per CONSUMED chunk)."""
+    e = make_engine()
+    ref = [t for t, _ in e.generate_stream([5, 9], 30, temperature=0.0, chunk=8)]
+    eos = ref[12]  # interior of chunk 2 (prompt 2 + sample 1 + chunk of 8 = 11)
+    e2 = make_engine()
+    out = [t for t, _ in e2.generate_stream([5, 9], 30, temperature=0.0,
+                                            chunk=8, eos_ids=(eos,))]
+    assert out[-1] == eos
+    gen_after_first = len(out) - len([5, 9]) - 1  # chunked tokens incl EOS
+    consumed_chunks = -(-gen_after_first // 8)
+    assert e2._chunk_counter == 1 + consumed_chunks
+    # and the rewound position still matches the serial contract
+    assert e2.pos == len(out) - 1
+
+
+def test_steps_prompt_plus_one_returns_cleanly():
+    """steps == prompt+1 (API max_tokens=1): the one token comes from the
+    prefill-logits sample and NO chunk is dispatched (a k=0 dispatch
+    would div-by-zero in the stats and burn a phantom RNG tick)."""
+    e = make_engine()
+    out = [t for t, _ in e.generate_stream([5, 9], 3, temperature=0.0, chunk=8)]
+    assert len(out) == 3
+    assert e._chunk_counter == 1  # just the post-prefill sample
+
+
+def test_abandoned_stream_rolls_back_speculative_tick():
+    """A consumer that abandons the generator mid-chunk (the stop-string
+    break in drain_generation) must also return the speculative in-flight
+    chunk's RNG tick — GeneratorExit runs the same rollback as EOS."""
+    e = make_engine()
+    gen = e.generate_stream([5, 9], 30, temperature=0.0, chunk=4)
+    for _ in range(2 + 1 + 2):  # prompt echo + first sample + 2 chunk tokens
+        next(gen)
+    gen.close()
+    # consumed ticks: post-prefill sample + chunk 1; speculative chunk 2's
+    # tick was rolled back on close
+    assert e._chunk_counter == 2
