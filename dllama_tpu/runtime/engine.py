@@ -439,21 +439,28 @@ class Engine:
             sub = jax.random.fold_in(self._key, self._chunk_counter)
             self._chunk_counter += 1
             p0 = self.pos
+            # host→device bytes actually crossing for THIS dispatch: the
+            # pos scalar + folded key always; the token array only when it
+            # comes from the host (first chunk) — later chunks feed the
+            # device-carried last token, which never touches the host
+            sent = 12 + (in_tok_dev.nbytes
+                         if isinstance(in_tok_dev, np.ndarray) else 0)
             t0 = time.perf_counter()
             with active_mesh(self.mesh):
                 toks_dev, self.cache, last_dev, _pos, _key = fn(
-                    self.params, self.cache, in_tok_dev, jnp.int32(p0), sub)
+                    self.params, self.cache, jnp.asarray(in_tok_dev),
+                    jnp.int32(p0), sub)
             self.pos = p0 + k
-            return k, p0, toks_dev, last_dev, t0
+            return k, p0, toks_dev, last_dev, t0, sent
 
         if produced >= steps or self.pos >= self.seq_len:
             return  # nothing left to dispatch (e.g. max_tokens == 1)
-        pending = dispatch(jnp.full((self.batch,), token, jnp.int32), produced)
+        pending = dispatch(np.full((self.batch,), token, np.int32), produced)
         expected = produced
         boundary = None
         try:
             while pending is not None:
-                k, p0, toks_dev, last_dev, t0 = pending
+                k, p0, toks_dev, last_dev, t0, sent = pending
                 expected += k
                 pending = dispatch(last_dev, expected) \
                     if expected < steps and self.pos < self.seq_len else None
@@ -475,7 +482,7 @@ class Engine:
                     generation_ms=(t2 - g0) * 1000 / k,
                     inference_ms=i_ms,
                     transfer_ms=t_ms,
-                    sent_bytes=(self.batch * 4 + 8) / k,
+                    sent_bytes=sent / k,
                     recv_bytes=toks.nbytes / k)
                 for j, tk in enumerate(toks.tolist()):
                     token = int(tk)
