@@ -579,21 +579,43 @@ class Engine:
             jnp.asarray(logits), sub, temperature, topp))  # (B,)
         yield tok_vec
 
-        while self.pos < self.seq_len:
-            k = min(chunk, self.seq_len - self.pos)
+        # depth-1 pipelined dispatch, mirroring generate_stream: chunk N+1
+        # is enqueued on the device-carried last row of tokens before
+        # chunk N's fetch, overlapping the host dispatch bubble with
+        # device execution.  Consumers break when every row is done
+        # (GeneratorExit) — the finally returns the speculative chunk's
+        # RNG tick; its cache rows are dead (the batch is one-shot and
+        # reset() precedes reuse).
+        def dispatch(in_tok, done):
+            # ``done`` = steps already covered by prior dispatches, so a
+            # speculative chunk never runs past the consumer's budget
+            k = min(chunk, steps - done, self.seq_len - self.pos)
             fn = self._chunk_fn(k, temperature, topp)
             sub = jax.random.fold_in(self._key, self._chunk_counter)
             self._chunk_counter += 1
             with active_mesh(self.mesh):
-                toks_dev, self.cache, _last, _pos, _key = fn(
-                    self.params, self.cache,
-                    jnp.asarray(tok_vec, jnp.int32), jnp.int32(self.pos), sub,
-                    self._offsets)
-            toks = np.asarray(toks_dev)  # (k, B)
+                toks_dev, self.cache, last_dev, _pos, _key = fn(
+                    self.params, self.cache, jnp.asarray(in_tok, jnp.int32),
+                    jnp.int32(self.pos), sub, self._offsets)
             self.pos += k
-            for j in range(toks.shape[0]):
-                yield toks[j]
-            tok_vec = toks[-1]
+            return k, toks_dev, last_dev
+
+        expected = 1  # the prefill-sample step already yielded
+        if expected >= steps or self.pos >= self.seq_len:
+            return
+        pending = dispatch(tok_vec, expected)
+        try:
+            while pending is not None:
+                k, toks_dev, last_dev = pending
+                expected += k
+                pending = dispatch(last_dev, expected) \
+                    if expected < steps and self.pos < self.seq_len else None
+                toks = np.asarray(toks_dev)  # (k, B)
+                for j in range(toks.shape[0]):
+                    yield toks[j]
+        finally:
+            if pending is not None:
+                self._chunk_counter -= 1
 
     # ------------------------------------------------------------------
     def score_batch(self, sequences: list[list[int]], top_k: int = 0
