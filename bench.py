@@ -979,14 +979,28 @@ def main():
                             "BENCH_insession.json")
         with open(path) as f:
             cand = json.loads(f.read().strip())
-        # freshness gate: a capture from THIS round only (rounds run ~12 h;
-        # the artifact is committed, so a later dead-relay round must not
-        # replay it as current evidence).  hw_capture stamps captured_unix;
-        # an unstamped artifact is treated as stale — file mtime would
-        # reset to "now" on any fresh checkout, defeating the gate.
-        age_s = time.time() - float(cand.get("captured_unix") or 0)
+        # freshness gate: a capture from THIS round only (the artifact is
+        # committed, so a later dead-relay round must not replay it as
+        # current evidence).  Primary check: the round stamp vs the
+        # driver's PROGRESS.jsonl (exact).  Fallback when either side
+        # lacks a round: captured_unix within 14 h (rounds run ~12 h and
+        # captures land mid-round; an unstamped artifact is stale — file
+        # mtime would reset to "now" on a fresh checkout).
+        cur_round = None
+        try:
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "PROGRESS.jsonl")) as pf:
+                cur_round = int(json.loads(
+                    pf.read().strip().splitlines()[-1])["round"])
+        except Exception:
+            pass
+        if cand.get("round") is not None and cur_round is not None:
+            fresh = int(cand["round"]) == cur_round
+        else:
+            fresh = (time.time() - float(cand.get("captured_unix") or 0)
+                     < 14 * 3600)
         if cand.get("metric") and cand.get("value", 0) > 0 \
-                and "DEGRADED" not in cand["metric"] and age_s < 12 * 3600:
+                and "DEGRADED" not in cand["metric"] and fresh:
             insession = cand
     except Exception:
         pass
@@ -1005,6 +1019,7 @@ def main():
         print("bench: emitting the committed in-session TPU capture "
               "(relay down at round end)", file=sys.stderr)
         insession.pop("captured_unix", None)
+        insession.pop("round", None)
         insession["metric"] += " [in-session capture; relay down at round end]"
         extras = insession.pop("extras", None) or {}
         _bank_term_result(dict(insession, **({"extras": extras} if extras else {})))

@@ -560,11 +560,12 @@ class Engine:
         belongs to the consumer (generate_batch truncates per row; the
         API server streams per-row deltas with its own stop detectors) —
         finished rows keep decoding in lockstep and their later tokens
-        are simply ignored.  The stream ends at the context window;
-        consumers that want fewer tokens must stop iterating (both
-        built-in consumers break when every row is done).  Abandoning the
-        generator mid-batch is fine: the batch is one-shot, not a
-        continuable conversation."""
+        are simply ignored.  The stream ends at ``steps`` total yields or
+        the context window, whichever first (every row's per-prompt cap
+        lies below ``steps``, see generate_batch); consumers that want
+        fewer tokens stop iterating (both built-in consumers break when
+        every row is done).  Abandoning the generator mid-batch is fine:
+        the batch is one-shot, not a continuable conversation."""
         from .decode_loop import device_sample
         if steps <= 0:
             raise ValueError("steps must be positive")

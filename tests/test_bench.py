@@ -100,8 +100,19 @@ def test_dead_relay_emits_insession_capture():
     art = json.loads(open(art_path).read().strip())
     if not art.get("value") or "DEGRADED" in art.get("metric", ""):
         pytest.skip("in-session artifact is not hardware evidence")
-    age_s = time.time() - float(art.get("captured_unix") or 0)
-    if age_s >= 12 * 3600:  # mirror bench's freshness gate
+    # mirror bench's freshness gate exactly: round stamp first, 14 h
+    # timestamp fallback
+    cur_round = None
+    try:
+        cur_round = int(json.loads(open(os.path.join(REPO, "PROGRESS.jsonl"))
+                                   .read().strip().splitlines()[-1])["round"])
+    except OSError:
+        pass
+    if art.get("round") is not None and cur_round is not None:
+        fresh = int(art["round"]) == cur_round
+    else:
+        fresh = time.time() - float(art.get("captured_unix") or 0) < 14 * 3600
+    if not fresh:
         pytest.skip("in-session artifact is stale; bench correctly "
                     "prefers the degraded path")
     env = dict(os.environ)

@@ -159,11 +159,24 @@ def load_art() -> dict:
         return {}
 
 
+def current_round() -> int | None:
+    """The driver's round number, from PROGRESS.jsonl's last line."""
+    try:
+        with open(os.path.join(HERE, "PROGRESS.jsonl")) as f:
+            return int(json.loads(f.read().strip().splitlines()[-1])["round"])
+    except Exception:
+        return None
+
+
 def save_art(art: dict) -> None:
-    # captured_unix feeds bench.py's round-end freshness gate: a committed
-    # artifact from a PREVIOUS round must not be replayed as current
-    # hardware evidence
+    # captured_unix + round feed bench.py's round-end freshness gate: a
+    # committed artifact from a PREVIOUS round must not be replayed as
+    # current hardware evidence (the round stamp is exact; the timestamp
+    # is the fallback when either side lacks one)
     art["captured_unix"] = time.time()
+    rnd = current_round()
+    if rnd is not None:
+        art["round"] = rnd
     with open(ART, "w") as f:
         f.write(json.dumps(art) + "\n")
 
