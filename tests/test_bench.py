@@ -101,13 +101,8 @@ def test_dead_relay_emits_insession_capture():
     if not art.get("value") or "DEGRADED" in art.get("metric", ""):
         pytest.skip("in-session artifact is not hardware evidence")
     # mirror bench's freshness gate exactly: round stamp first, 14 h
-    # timestamp fallback
-    cur_round = None
-    try:
-        cur_round = int(json.loads(open(os.path.join(REPO, "PROGRESS.jsonl"))
-                                   .read().strip().splitlines()[-1])["round"])
-    except OSError:
-        pass
+    # timestamp fallback — same parser bench uses
+    cur_round = bench.current_round()
     if art.get("round") is not None and cur_round is not None:
         fresh = int(art["round"]) == cur_round
     else:

@@ -45,7 +45,7 @@ SWEEP = os.path.join(HERE, "tools", "sweep_results.txt")
 BENCH = os.path.join(HERE, "bench.py")
 
 sys.path.insert(0, HERE)
-from bench import _with_compile_cache  # noqa: E402  (shared cache env recipe)
+from bench import _with_compile_cache, current_round  # noqa: E402  (shared recipes)
 
 # the in-flight child, killed from the SIGTERM handler: if the watcher's
 # outer timeout tears THIS process down mid-attempt, the bench child must
@@ -157,15 +157,6 @@ def load_art() -> dict:
             return json.loads(f.read().strip())
     except Exception:
         return {}
-
-
-def current_round() -> int | None:
-    """The driver's round number, from PROGRESS.jsonl's last line."""
-    try:
-        with open(os.path.join(HERE, "PROGRESS.jsonl")) as f:
-            return int(json.loads(f.read().strip().splitlines()[-1])["round"])
-    except Exception:
-        return None
 
 
 def save_art(art: dict) -> None:
@@ -386,7 +377,10 @@ def main() -> int:
             # 13B compiles every 40-layer kernel shape fresh over the
             # tunnel — give it the same headroom bench.py budgets (600+)
             ("llama2-13b", "llama2-13b_toks", "13B decode (reference row "
-             "README.md:127)", 900)):
+             "README.md:127)", 900),
+            ("llama2-7b-q8w", "llama2-7b_q80w_toks",
+             "Q80-weights decode (first hardware number for the fused "
+             "Q80 kernel)", 600)):
         if key in extras:
             continue
         if not relay_up():
