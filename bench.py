@@ -365,14 +365,7 @@ def _bench_decode(cfg, chunk=32, n_chunks=10, profile=False, start_pos=0,
     from dllama_tpu.models.transformer import init_kv_cache
     from dllama_tpu.runtime.decode_loop import decode_chunk
 
-    params = _zero_q40_params(cfg, codec)
-    if os.environ.get("DLLAMA_Q40_LAYOUT", "") == "blocked":
-        # tile-contiguous storage lever (ops/q40.py BlockedQTensor) — the
-        # capture's combined re-run flips this env when the blocked probe
-        # wins on raw bandwidth
-        from dllama_tpu.ops import q40 as _q40
-        params = _q40.blocked_params(params)
-        print("bench: blocked (tile-contiguous) Q40 layout", file=sys.stderr)
+    params = maybe_blocked(_zero_q40_params(cfg, codec), codec)
     cache = init_kv_cache(cfg, batch=batch, quant=kv_quant)
 
     fn = jax.jit(
@@ -422,6 +415,18 @@ def _bench_decode(cfg, chunk=32, n_chunks=10, profile=False, start_pos=0,
     return float(np.mean(times))
 
 
+def maybe_blocked(params, codec="q40"):
+    """Apply the tile-contiguous layout lever when the env asks for it —
+    the ONE shared recipe (bench decode/prefill, tools/profile_decode.py).
+    Q40 only: blocked_params is a no-op on Q8 planes, and claiming the
+    layout for a q80 run would mislabel the measurement."""
+    if os.environ.get("DLLAMA_Q40_LAYOUT", "") == "blocked" and codec == "q40":
+        from dllama_tpu.ops import q40 as _q40
+        params = _q40.blocked_params(params)
+        print("bench: blocked (tile-contiguous) Q40 layout", file=sys.stderr)
+    return params
+
+
 def _bench_prefill(cfg, T=512, reps=6):
     """Avg ms/token over ``reps`` bucketed prefill forwards (compile +
     warmup excluded).  The cache is NOT donated — each rep rewrites the
@@ -432,7 +437,7 @@ def _bench_prefill(cfg, T=512, reps=6):
     import numpy as np
     from dllama_tpu.models.transformer import forward_last, init_kv_cache
 
-    params = _zero_q40_params(cfg)
+    params = maybe_blocked(_zero_q40_params(cfg))
     cache = init_kv_cache(cfg, batch=1)
     fn = jax.jit(lambda p, c, t: forward_last(p, cfg, t, c, jnp.int32(0),
                                               jnp.int32(T - 1)))
