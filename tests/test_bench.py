@@ -121,3 +121,26 @@ def test_dead_relay_emits_insession_capture():
     obj = json.loads(lines[0])
     assert "in-session capture" in obj["metric"]
     assert obj["value"] == art["value"]
+
+
+def test_maybe_blocked_applies_to_q40_only(monkeypatch):
+    """The blocked-layout lever converts Q40 params and refuses to claim
+    the layout for q80 runs (blocked_params is a no-op on Q8 planes; the
+    banner would mislabel the measurement)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from dllama_tpu.ops import q40
+    from dllama_tpu.ops.q8 import Q8Tensor
+
+    monkeypatch.setenv("DLLAMA_Q40_LAYOUT", "blocked")
+    qt = q40.quantize(
+        (np.random.RandomState(0).randn(2, 64, 32) * 0.1).astype(np.float32))
+    out = bench.maybe_blocked({"a": qt})
+    assert isinstance(out["a"], q40.BlockedQTensor)
+    q8t = Q8Tensor(jnp.zeros((2, 64, 32), jnp.int8),
+                   jnp.zeros((2, 2, 32), jnp.uint16), (64, 32))
+    out2 = bench.maybe_blocked({"b": q8t}, codec="q80")
+    assert out2["b"] is q8t
+    monkeypatch.delenv("DLLAMA_Q40_LAYOUT")
+    out3 = bench.maybe_blocked({"a": qt})
+    assert out3["a"] is qt  # lever off → untouched
