@@ -303,28 +303,31 @@ def _profile_split_stderr(run_once, chunk):
               file=sys.stderr)
 
 
-def _pallas_hw_check():
+def _pallas_hw_check(codec="q40"):
     """Non-interpret fused-kernel equality check on the real backend
-    (VERDICT r01: Mosaic breakage must be visible in the artifact).
+    (VERDICT r01: Mosaic breakage must be visible in the artifact), for
+    the codec the stage will actually bench — a q40 verdict says nothing
+    about the Q80 kernel's lowering and vice versa.
     Returns 'pallas' if the fused kernel is usable, else 'xla'."""
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from dllama_tpu.ops import q40
+    from dllama_tpu.ops import q40, q8
 
     if jax.default_backend() == "cpu":
         return "xla"
+    mod = q40 if codec == "q40" else q8
     try:
         rng = np.random.RandomState(0)
         w = (rng.randn(2048, 512) * 0.1).astype(np.float32)
         x = jnp.asarray(rng.randn(1, 2048).astype(np.float32), jnp.bfloat16)
-        qt = q40.quantize(w)
-        out_p = np.asarray(q40.matmul(x, qt, impl="pallas"))
-        out_x = np.asarray(q40.matmul(x, qt, impl="xla"))
+        qt = mod.quantize(w)
+        out_p = np.asarray(mod.matmul(x, qt, impl="pallas"))
+        out_x = np.asarray(mod.matmul(x, qt, impl="xla"))
         err = float(np.max(np.abs(out_p - out_x)) / (np.max(np.abs(out_x)) + 1e-9))
         if err > 2e-2:
             raise AssertionError(f"pallas/xla mismatch, rel err {err:.3g}")
-        if os.environ.get("DLLAMA_Q40_LAYOUT", "") == "blocked":
+        if codec == "q40" and os.environ.get("DLLAMA_Q40_LAYOUT", "") == "blocked":
             # probe the blocked kernel's Mosaic lowering too: the static
             # tile predicate (_blocked_tiles_ok) cannot prove lowerability
             # at real shapes, and a compile failure must downgrade the run
@@ -341,11 +344,12 @@ def _pallas_hw_check():
                 raise AssertionError(f"blocked mismatch, rel err {err_b:.3g}")
             print(f"pallas hardware check: blocked layout OK "
                   f"(max rel err {err_b:.2e})", file=sys.stderr)
-        print(f"pallas hardware check: OK (max rel err {err:.2e})", file=sys.stderr)
+        print(f"pallas hardware check ({codec}): OK (max rel err {err:.2e})",
+              file=sys.stderr)
         return "pallas"
     except Exception as e:
-        print(f"pallas hardware check FAILED ({type(e).__name__}: {str(e)[:160]}); "
-              "benching the XLA dequant path", file=sys.stderr)
+        print(f"pallas hardware check ({codec}) FAILED ({type(e).__name__}: "
+              f"{str(e)[:160]}); benching the XLA dequant path", file=sys.stderr)
         return "xla"
 
 
@@ -528,7 +532,7 @@ def run_attempt(name):
         # compile or decode (the r05 post-profile failure signature)
         print(f"bench: {name}: claiming backend...", file=sys.stderr)
         print(f"bench: {name}: backend {jax.default_backend()}", file=sys.stderr)
-        impl = _pallas_hw_check()
+        impl = _pallas_hw_check(codec)
         chunk, n_chunks = 32, 10  # ≥10 timed chunks (ADVICE r02)
     if profile:
         n_chunks = 2  # the split needs one traced chunk, not a full rerun
