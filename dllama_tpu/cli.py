@@ -141,6 +141,29 @@ def build_parser() -> argparse.ArgumentParser:
                    help="api server: serve /v1/completions list-prompts as one "
                         "lockstep batch with this many slots (a second KV "
                         "cache; weights are shared)")
+    # ---- serving robustness (api server; docs/ROBUSTNESS.md) ----
+    p.add_argument("--host", default="0.0.0.0",
+                   help="api server: bind address (default 0.0.0.0)")
+    p.add_argument("--max-pending", type=int, default=8,
+                   help="api server: max requests in flight or queued; "
+                        "excess get 429 + Retry-After (bounded admission)")
+    p.add_argument("--request-timeout", type=float, default=0.0,
+                   help="api server: default per-request deadline in seconds "
+                        "(0 = none); requests may lower it with a 'timeout' "
+                        "body field.  Expired requests return a truncated "
+                        "completion with finish_reason=\"timeout\"")
+    p.add_argument("--io-timeout", type=float, default=15.0,
+                   help="api server: socket read/write timeout; a client "
+                        "stalled sending its body gets 408, one stalled "
+                        "reading a stream is treated as disconnected")
+    p.add_argument("--drain-grace", type=float, default=30.0,
+                   help="api server: on SIGTERM/SIGINT, seconds granted to "
+                        "in-flight requests before their deadlines clamp")
+    p.add_argument("--step-timeout", type=float, default=None,
+                   help="watchdog: seconds a device step may block before "
+                        "StepTimeout (default DLLAMA_STEP_TIMEOUT or none); "
+                        "turns a silently hung device into a diagnosable "
+                        "error naming the step, position and mesh")
     return p
 
 
@@ -172,7 +195,8 @@ def load_stack(args, batch: int | None = None) -> tuple[Engine, Tokenizer]:
                 else jnp.dtype(DTYPES[args.kv_cache_dtype])
                 if args.kv_cache_dtype else None)
     engine = Engine(cfg, params, mesh=mesh, seq_len=args.max_seq_len,
-                    kv_dtype=kv_dtype, batch=batch or max(args.dp, 1))
+                    kv_dtype=kv_dtype, batch=batch or max(args.dp, 1),
+                    step_timeout=getattr(args, "step_timeout", None))
     tok = Tokenizer(tfile.read_tfile(args.tokenizer))
     if tok.vocab_size != cfg.vocab_size:
         raise SystemExit("tokenizer is incompatible with model (vocab size mismatch)")

@@ -40,13 +40,27 @@ def distributed_env() -> tuple[str | None, int | None, int | None] | None:
 
 def init_distributed(coordinator: str | None = None,
                      num_processes: int | None = None,
-                     process_id: int | None = None) -> int:
+                     process_id: int | None = None, *,
+                     max_retries: int | None = None,
+                     backoff: float | None = None,
+                     max_backoff: float = 30.0) -> int:
     """Join (or create, as process 0) the multi-host process group.
 
     Arguments fall back to the DLLAMA_* environment variables.  Returns the
     process id.  Must run before the first device query in the process —
     the same constraint the backend pinning imposes everywhere else
     (hostenv.py).
+
+    Connection failures retry with exponential backoff (``backoff``, then
+    ×2 per attempt, capped at ``max_backoff``; ``max_retries`` extra
+    attempts, env defaults ``DLLAMA_INIT_RETRIES``/``DLLAMA_INIT_BACKOFF``,
+    5 and 0.5 s).  The coordinator not being up yet is the NORMAL case
+    under the reference's start-order contract ("start workers first,
+    then root", socket.cpp:174-178): non-zero processes routinely launch
+    before process 0's coordination service is listening, and a
+    fail-fast here — the pre-retry behavior — forces operators to
+    hand-sequence the fleet.  Argument/spec errors (ValueError) never
+    retry.  docs/ROBUSTNESS.md covers the contract.
     """
     env = distributed_env()
     if env is not None:
@@ -64,13 +78,38 @@ def init_distributed(coordinator: str | None = None,
         # defaulting to 0 would register every such host as the root and
         # deadlock the coordinator waiting for the missing ids
         raise ValueError("--proc-id is required when --nproc > 1")
+    if max_retries is None:
+        max_retries = int(os.environ.get("DLLAMA_INIT_RETRIES", "5"))
+    if backoff is None:
+        backoff = float(os.environ.get("DLLAMA_INIT_BACKOFF", "0.5"))
+    import time
+
     import jax
 
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=num_processes if num_processes is not None else 1,
-        process_id=process_id if process_id is not None else 0)
-    return jax.process_index()
+    from ..runtime.faults import FAULTS
+
+    for attempt in range(max_retries + 1):
+        try:
+            FAULTS.fire("distributed.initialize")
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes if num_processes is not None else 1,
+                process_id=process_id if process_id is not None else 0)
+            return jax.process_index()
+        except ValueError:
+            raise  # bad coordinates, not a transient connection failure
+        except (ConnectionError, OSError, RuntimeError) as e:
+            # jax surfaces grpc connect/deadline failures as RuntimeError;
+            # ConnectionError/OSError cover the socket layer underneath
+            if attempt >= max_retries:
+                raise
+            delay = min(backoff * (2 ** attempt), max_backoff)
+            import sys
+            print(f"⚠️  coordinator {coordinator} not reachable "
+                  f"(attempt {attempt + 1}/{max_retries + 1}: {e}); "
+                  f"retrying in {delay:.2f}s", file=sys.stderr)
+            time.sleep(delay)
+    raise AssertionError("unreachable")  # the loop returns or raises
 
 
 def is_output_process() -> bool:

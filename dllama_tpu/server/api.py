@@ -16,27 +16,60 @@ Re-implements `/root/reference/src/apps/dllama-api/dllama-api.cpp`:
   conversation prefix exactly, generation resumes from the cached KV
   position instead of re-prefilling the whole history.
 
-Single-threaded request handling like the reference's accept loop
-(:418-429) — each engine owns one KV cache, so requests serialize; the
-accept queue IS the request queue (concurrent clients block, then get
-served in order — see tests/test_api.py's concurrency test).
+**Request lifecycle & fault tolerance** (beyond reference — the
+reference's accept loop is single-threaded blocking I/O, :418-429, and a
+stalled client wedges the whole server): requests are handled on threads
+(``ThreadingHTTPServer``) with a single **engine mutex** serializing
+generation — each engine owns one KV cache, so the mutex queue IS the
+request queue — plus:
+
+* **bounded admission**: at most ``--max-pending`` requests in flight or
+  queued; excess get ``429`` + ``Retry-After`` instead of an unbounded
+  backlog (tail latency stays diagnosable under overload).
+* **per-request deadlines**: a ``timeout``/``max_time`` body field (and
+  ``--request-timeout`` server default) is enforced between decode
+  chunks; an expired request returns a well-formed truncated completion
+  with ``finish_reason="timeout"``.
+* **socket I/O timeouts** (``--io-timeout``): a stalled client reading
+  the body gets ``408``; a stalled reader mid-stream is treated as a
+  disconnect.  Client disconnects cancel generation at the next chunk
+  and rewind ``engine.pos`` (the runtime/stream.py invariant).
+* **graceful drain**: SIGTERM/SIGINT stop accepting (new requests get
+  ``503``), finish in-flight requests bounded by ``--drain-grace``, then
+  exit (see :func:`serve`).
+* **observability**: ``/health`` reports readiness + queue depth;
+  ``/metrics`` exports counters (served, 429s, timeouts, disconnects).
+* every degraded path above is deterministically testable through the
+  fault registry (``runtime/faults.py``; ``DLLAMA_FAULTS`` arms a live
+  server, ``tools/fault_drill.py`` drives one end to end).
+
 Uses only the standard library (the reference vendors nlohmann/json;
-Python's ``json`` plays that role).
+Python's ``json`` plays that role).  docs/ROBUSTNESS.md has the full
+semantics.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
+import os
+import signal
+import threading
 import time
 import uuid
 from dataclasses import dataclass, field
-from http.server import BaseHTTPRequestHandler, HTTPServer
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from ..runtime.engine import ContextOverflow, Engine
+from ..runtime.engine import ContextOverflow, Engine, StepTimeout
+from ..runtime.faults import FAULTS
 from ..runtime.stream import drain_generation
 from ..tokenizer.bpe import Tokenizer
 from ..tokenizer.chat import ChatItem, ChatTemplate, TokenizerChatStops
 from ..tokenizer.eos import EosDetector
+
+#: request bodies above this are refused with 413 (an unbounded
+#: Content-Length read is an easy memory DoS against a model server)
+MAX_BODY_BYTES = 8 * 1024 * 1024
 
 
 def _decode_continuation(tok: Tokenizer, prev: int, token_ids: list[int]) -> str:
@@ -130,6 +163,73 @@ def parse_request(body: dict, default_temp: float, default_topp: float) -> Infer
     return p
 
 
+@dataclass
+class ServerMetrics:
+    """Serving counters, aggregated like RunStats aggregates step stats —
+    one process-lifetime object, exported verbatim at ``/metrics``."""
+    started_at: float = field(default_factory=time.time)
+    requests_served: int = 0
+    requests_rejected_429: int = 0
+    requests_rejected_503: int = 0
+    read_timeouts_408: int = 0
+    deadline_timeouts: int = 0
+    client_disconnects: int = 0
+    server_errors: int = 0
+    avg_request_s: float = 0.0  # EMA; feeds the Retry-After hint
+
+    def __post_init__(self):
+        self._lock = threading.Lock()
+
+    def bump(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + n)
+
+    def observe_duration(self, seconds: float) -> None:
+        with self._lock:
+            a = self.avg_request_s
+            self.avg_request_s = seconds if a == 0.0 else 0.8 * a + 0.2 * seconds
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "uptime_s": round(time.time() - self.started_at, 3),
+                "requests_served": self.requests_served,
+                "requests_rejected_429": self.requests_rejected_429,
+                "requests_rejected_503": self.requests_rejected_503,
+                "read_timeouts_408": self.read_timeouts_408,
+                "deadline_timeouts": self.deadline_timeouts,
+                "client_disconnects": self.client_disconnects,
+                "server_errors": self.server_errors,
+                "avg_request_s": round(self.avg_request_s, 6),
+            }
+
+
+def _bounded(stream, state: "ApiState", deadline: float | None,
+             is_aborted, flag: dict, n_prompt: int = 0):
+    """Wrap an engine token stream so generation stops *between tokens*
+    when the request deadline (or the server's drain deadline) passes or
+    the client has gone away.  The consumer (drain_generation) then runs
+    its normal end-of-stream path — held-back text flushes and
+    ``engine.pos`` rewinds exactly as for a budget-exhausted stream, so
+    cancellation reuses the one pos-rewind invariant instead of adding a
+    second.  ``flag`` reports why the stream ended early.
+
+    The deadline arms only after ``n_prompt`` + 1 items: the engine echoes
+    the prompt before the first sampled token, and a "timed out" response
+    must be a TRUNCATED completion, never an empty one — a cold server
+    whose prefill compile alone eats the deadline still owes one token."""
+    with contextlib.closing(stream):
+        for i, item in enumerate(stream):
+            yield item
+            if is_aborted is not None and is_aborted():
+                flag["aborted"] = True
+                return
+            d = state.effective_deadline(deadline)
+            if d is not None and i >= n_prompt and time.monotonic() >= d:
+                flag["timed_out"] = True
+                return
+
+
 class ApiState:
     """Engine + tokenizer + conversation cache shared across requests.
 
@@ -137,12 +237,20 @@ class ApiState:
     batch > 1 for /v1/completions list-prompt requests.  It shares the
     chat engine's *placed* weight buffers — Engine re-placement of an
     already-sharded array is a no-op — so the only extra HBM is its KV
-    cache."""
+    cache.
+
+    Request-lifecycle state (threaded server): ``engine_lock`` is THE
+    engine mutex — generation for both engines serializes under it (one
+    KV-cache conversation state, one device queue).  Admission is counted
+    in ``try_enter``/``leave``; ``begin_drain`` flips the server into
+    draining (reject new work, clamp in-flight deadlines)."""
 
     def __init__(self, engine: Engine, tokenizer: Tokenizer,
                  default_temperature: float = 0.7, default_topp: float = 0.9,
                  chunk: int = 16, model_name: str = "dllama-tpu",
-                 batch_engine: Engine | None = None):
+                 batch_engine: Engine | None = None,
+                 max_pending: int = 8, request_timeout: float = 0.0,
+                 io_timeout: float = 15.0, drain_grace: float = 30.0):
         self.engine = engine
         self.batch_engine = batch_engine
         self.tokenizer = tokenizer
@@ -155,12 +263,133 @@ class ApiState:
         self.base_stops = stops.stops
         eos = tokenizer.vocab[tokenizer.chat_eos_id].decode("utf-8", "replace")
         self.template = ChatTemplate(tokenizer.chat_template, eos)
+        # ---- robustness layer ----
+        self.max_pending = max_pending
+        self.request_timeout = request_timeout
+        self.io_timeout = io_timeout
+        self.drain_grace = drain_grace
+        self.engine_lock = threading.Lock()
+        self.metrics = ServerMetrics()
+        self._admit_lock = threading.Lock()
+        self._pending = 0   # admitted: queued on the mutex or generating
+        self._active = 0    # holding the engine mutex (0 or 1)
+        self.draining = False
+        self.drain_deadline: float | None = None
+
+    # -- admission / drain ---------------------------------------------
+    def try_enter(self) -> str:
+        """Admit one request: ``"ok"`` (caller MUST pair with ``leave``),
+        ``"full"`` (queue at capacity → 429) or ``"draining"`` (→ 503)."""
+        with self._admit_lock:
+            if self.draining:
+                return "draining"
+            if self._pending >= self.max_pending:
+                return "full"
+            self._pending += 1
+            return "ok"
+
+    def leave(self, duration_s: float) -> None:
+        with self._admit_lock:
+            self._pending -= 1
+        self.metrics.observe_duration(duration_s)
+
+    def mark_active(self, on: bool) -> None:
+        with self._admit_lock:
+            self._active += 1 if on else -1
+
+    def queue_depths(self) -> tuple[int, int]:
+        """(in_flight, queued) — for /health and Retry-After."""
+        with self._admit_lock:
+            return self._active, max(self._pending - self._active, 0)
+
+    def begin_drain(self, grace: float | None = None) -> None:
+        """Stop admitting; clamp every in-flight deadline to now+grace."""
+        with self._admit_lock:
+            self.draining = True
+            g = self.drain_grace if grace is None else grace
+            self.drain_deadline = time.monotonic() + max(g, 0.0)
+
+    def retry_after_hint(self) -> int:
+        """Retry-After seconds: queue depth × the EMA request duration
+        (floor 1s) — an honest backpressure hint, not a constant."""
+        with self._admit_lock:
+            depth = self._pending
+        avg = self.metrics.avg_request_s or 1.0
+        return max(1, min(int(depth * avg + 0.999), 60))
+
+    # -- deadlines ------------------------------------------------------
+    def request_deadline(self, body: dict) -> float | None:
+        """Absolute (monotonic) deadline for a request: the body's
+        ``timeout``/``max_time`` seconds, clamped by the server default
+        (``--request-timeout``); None when neither applies."""
+        t = body.get("timeout")
+        if t is None:
+            t = body.get("max_time")
+        try:
+            t = float(t) if t is not None else None
+        except (TypeError, ValueError):
+            t = None
+        if t is not None and t <= 0:
+            t = None
+        if self.request_timeout > 0:
+            t = self.request_timeout if t is None else min(t, self.request_timeout)
+        return time.monotonic() + t if t is not None else None
+
+    def effective_deadline(self, deadline: float | None) -> float | None:
+        """The request deadline clamped by the drain deadline (a drain
+        that starts mid-request shortens every in-flight request)."""
+        dd = self.drain_deadline
+        if dd is None:
+            return deadline
+        return dd if deadline is None else min(deadline, dd)
+
+    def health(self) -> dict:
+        """Readiness + liveness detail for ``/health`` (satellite: model
+        loaded, mesh shape, backend, queue depths, uptime)."""
+        eng = self.engine
+        try:
+            backend = eng.mesh.devices.flat[0].platform
+        except Exception:
+            backend = "unknown"
+        in_flight, queued = self.queue_depths()
+        return {
+            "status": "draining" if self.draining else "ok",
+            "ready": True,  # the model loads before serve() binds the port
+            "model": self.model_name,
+            "backend": backend,
+            "mesh": {k: int(v) for k, v in dict(eng.mesh.shape).items()},
+            "seq_len": eng.seq_len,
+            "batch_slots": self.batch_engine.batch if self.batch_engine else 0,
+            "in_flight": in_flight,
+            "queued": queued,
+            "max_pending": self.max_pending,
+            "uptime_s": round(time.time() - self.metrics.started_at, 3),
+            "requests_served": self.metrics.requests_served,
+        }
 
     # ------------------------------------------------------------------
-    def complete(self, params: InferenceParams, emit):
-        """Run one chat completion; calls ``emit(delta_text)`` as text becomes
-        safe to stream.  Returns (content, n_prompt_tokens, n_completion_tokens)."""
+    def complete(self, params: InferenceParams, emit, *,
+                 deadline: float | None = None, is_aborted=None):
+        """Run one chat completion; calls ``emit(delta_text)`` as text
+        becomes safe to stream.  Returns ``(content, n_prompt_tokens,
+        n_completion_tokens, finish_reason)`` with finish_reason ``"stop"``
+        (eos/stop/budget — the pre-deadline contract), ``"timeout"``
+        (deadline expired between chunks) or ``"aborted"`` (client gone;
+        the caller sends nothing further).
+
+        Cancellation safety: the deadline/abort checks live in a wrapper
+        *around* the engine stream (:func:`_bounded`), so every early
+        exit flows through drain_generation's single end-of-stream path —
+        held-back text flushes, ``engine.pos`` rewinds to the consumed
+        prefix, and the conversation cache records exactly the state the
+        KV cache holds.  A disconnected client therefore never poisons
+        the next request's cache resume."""
         engine, tok = self.engine, self.tokenizer
+        if deadline is not None and time.monotonic() >= deadline:
+            # expired while queued on the engine mutex: answer without
+            # burning a prefill (the 429/Retry-After path exists so
+            # clients can avoid this; some will miss anyway under load)
+            return "", 0, 0, "timeout"
 
         start_pos, delta_messages = self.naive_cache.resolve_delta_prompt(params.messages)
         if start_pos == 0:
@@ -193,13 +422,23 @@ class ApiState:
             prompt_tokens, budget, temperature=params.temperature,
             topp=params.top_p, seed=seed, chunk=self.chunk,
             eos_ids=(tok.chat_eos_id,))
+        flag: dict = {}
+        if deadline is not None or is_aborted is not None \
+                or self.drain_deadline is not None:
+            stream = _bounded(stream, self, deadline, is_aborted, flag,
+                              n_prompt=len(prompt_tokens))
         reply, n_completion, _ = drain_generation(
             engine, tok, detector, stream, len(prompt_tokens), prompt_end, emit)
         if engine.pos >= engine.seq_len:
             self.naive_cache.clear()  # context exhausted (dllama-api.cpp:330-331)
         else:
+            # on timeout/disconnect this records the PARTIAL reply at the
+            # rewound pos — cache and KV state stay consistent, which is
+            # the whole invariant (a poisoned entry would corrupt resumes)
             self.naive_cache.push(engine.pos, ChatMessage("assistant", reply))
-        return reply, len(prompt_tokens), n_completion
+        finish = "aborted" if flag.get("aborted") \
+            else "timeout" if flag.get("timed_out") else "stop"
+        return reply, len(prompt_tokens), n_completion, finish
 
     # ------------------------------------------------------------------
     def _plan_ids(self, id_lists: list[list[int]], max_tokens: int,
@@ -232,7 +471,45 @@ class ApiState:
             budget = min(longest + max_tokens, eng.seq_len)
         return padded, n_real, budget, eos_id
 
-    def complete_n(self, params: InferenceParams
+    def _drain_batch(self, id_lists: list[list[int]], budget: int, *,
+                     temperature: float, top_p: float, seed: int | None,
+                     eos_id: int, deadline: float | None = None
+                     ) -> tuple[list[list[int]], list[bool]]:
+        """Consume one lockstep batch generation (Engine.generate_batch
+        semantics: per-row EOS/budget truncation) with a deadline check
+        between device chunks — the batch twin of :func:`_bounded`.
+        Returns ``(outs, timed_out_per_row)``; rows cut by the deadline
+        keep whatever they had decoded.  The batch engine is one-shot
+        (reset precedes every use), so early exit needs no pos rewind —
+        only the generator close, which returns the speculative chunk's
+        RNG tick (engine contract)."""
+        eng = self.batch_engine
+        eng.reset()
+        outs = [list(p) for p in id_lists]
+        done = [len(o) >= budget for o in outs]
+        timed = [False] * len(outs)
+        stream = eng.generate_batch_stream(
+            id_lists, budget, temperature=temperature, topp=top_p,
+            seed=seed if seed is not None else int(time.time()),
+            chunk=self.chunk)
+        with contextlib.closing(stream):
+            for row_tokens in stream:
+                for r, t in enumerate(row_tokens.tolist()):
+                    if done[r]:
+                        continue
+                    outs[r].append(int(t))
+                    if int(t) == eos_id or len(outs[r]) >= budget:
+                        done[r] = True
+                if all(done):
+                    break
+                d = self.effective_deadline(deadline)
+                if d is not None and time.monotonic() >= d:
+                    timed = [not dn for dn in done]
+                    break
+        return outs, timed
+
+    def complete_n(self, params: InferenceParams,
+                   deadline: float | None = None
                    ) -> tuple[list[str], int, int]:
         """``n > 1`` chat choices: the templated prompt replicated n times
         decodes as one lockstep batch on ``batch_engine`` — n *sampled*
@@ -252,17 +529,15 @@ class ApiState:
         prompt_tokens = tok.encode(text, add_bos=True)
         id_lists, _, budget, eos_id = self._plan_ids(
             [prompt_tokens] * params.n, params.max_tokens, tok.chat_eos_id)
-        eng.reset()
-        outs = eng.generate_batch(
+        outs, timed = self._drain_batch(
             id_lists, budget, temperature=params.temperature,
-            topp=params.top_p,
-            seed=params.seed if params.seed is not None else int(time.time()),
-            eos_ids=(eos_id,), chunk=self.chunk)
+            top_p=params.top_p, seed=params.seed, eos_id=eos_id,
+            deadline=deadline)
         choices = []
         n_completion = 0
         for r in range(params.n):
             comp = outs[r][len(prompt_tokens):]
-            finish = "length"  # OpenAI truncation signal: cap, no eos
+            finish = "timeout" if timed[r] else "length"
             if comp and comp[-1] == eos_id:
                 comp = comp[:-1]
                 finish = "stop"
@@ -298,7 +573,8 @@ class ApiState:
     def complete_batch(self, prompts: list[str], *, temperature: float,
                        top_p: float, max_tokens: int, seed: int | None,
                        stop: list[str], echo: bool = False,
-                       logprobs: int | None = None
+                       logprobs: int | None = None,
+                       deadline: float | None = None
                        ) -> tuple[list[dict], int, int]:
         """Run B distinct prompts as one lockstep batch on ``batch_engine``.
 
@@ -316,11 +592,9 @@ class ApiState:
         """
         eng, tok = self.batch_engine, self.tokenizer
         id_lists, n_real, budget, eos_id = self.plan_batch(prompts, max_tokens)
-        eng.reset()
-        outs = eng.generate_batch(
-            id_lists, budget, temperature=temperature, topp=top_p,
-            seed=seed if seed is not None else int(time.time()),
-            eos_ids=(eos_id,), chunk=self.chunk)
+        outs, timed = self._drain_batch(
+            id_lists, budget, temperature=temperature, top_p=top_p,
+            seed=seed, eos_id=eos_id, deadline=deadline)
         choices = []
         comps = []
         n_prompt = n_completion = 0
@@ -333,7 +607,7 @@ class ApiState:
             # would get served alone
             if max_tokens > 0:
                 comp = comp[:max_tokens]
-            finish = "length"
+            finish = "timeout" if timed[r] else "length"
             if comp and comp[-1] == eos_id:
                 comp = comp[:-1]
                 finish = "stop"
@@ -452,7 +726,9 @@ class ApiState:
     def complete_batch_stream(self, prompts: list[str], *, temperature: float,
                               top_p: float, max_tokens: int, seed: int | None,
                               stop: list[str], emit,
-                              plan: tuple | None = None) -> None:
+                              plan: tuple | None = None,
+                              deadline: float | None = None,
+                              is_aborted=None) -> None:
         """Streaming complement of :meth:`complete_batch`: drives the same
         lockstep batch but calls ``emit(row_index, delta_text,
         finish_reason_or_None)`` as each row's text becomes safe to send.
@@ -508,31 +784,45 @@ class ApiState:
                 emit(r, buf[r], None)
                 buf[r] = ""
 
-        for step_vec in eng.generate_batch_stream(
-                id_lists, budget, temperature=temperature, topp=top_p,
-                seed=seed if seed is not None else int(time.time()),
-                chunk=self.chunk):
-            for r in range(n_real):
-                if done[r]:
-                    continue
-                t = int(step_vec[r])
-                n_comp[r] += 1
-                if t == eos_id:
-                    # eos text never enters the reply; flush and close as
-                    # "stop" (a stop string firing in the buffer also ends
-                    # the row as "stop" — flush handles both)
-                    buf[r] += decoders[r].decode(b"", True)
-                    flush(r, closing=True, finish="stop")
-                    continue
-                buf[r] += decoders[r].decode(tok.decode_piece(prev[r], t))
-                prev[r] = t
-                if n_comp[r] >= cap[r]:
-                    buf[r] += decoders[r].decode(b"", True)
-                    flush(r, closing=True)
-                else:
-                    flush(r, closing=False)
-            if all(done):
-                break
+        stream = eng.generate_batch_stream(
+            id_lists, budget, temperature=temperature, topp=top_p,
+            seed=seed if seed is not None else int(time.time()),
+            chunk=self.chunk)
+        with contextlib.closing(stream):
+            for step_vec in stream:
+                for r in range(n_real):
+                    if done[r]:
+                        continue
+                    t = int(step_vec[r])
+                    n_comp[r] += 1
+                    if t == eos_id:
+                        # eos text never enters the reply; flush and close as
+                        # "stop" (a stop string firing in the buffer also ends
+                        # the row as "stop" — flush handles both)
+                        buf[r] += decoders[r].decode(b"", True)
+                        flush(r, closing=True, finish="stop")
+                        continue
+                    buf[r] += decoders[r].decode(tok.decode_piece(prev[r], t))
+                    prev[r] = t
+                    if n_comp[r] >= cap[r]:
+                        buf[r] += decoders[r].decode(b"", True)
+                        flush(r, closing=True)
+                    else:
+                        flush(r, closing=False)
+                if all(done):
+                    break
+                if is_aborted is not None and is_aborted():
+                    return  # client gone: nothing left worth decoding
+                d = self.effective_deadline(deadline)
+                if d is not None and time.monotonic() >= d:
+                    # deadline between chunks: close every live row as a
+                    # well-formed truncated stream (OpenAI shape, the
+                    # chat path's finish_reason="timeout" contract)
+                    for r in range(n_real):
+                        if not done[r]:
+                            buf[r] += decoders[r].decode(b"", True)
+                            flush(r, closing=True, finish="timeout")
+                    return
         for r in range(n_real):
             if not done[r]:  # budget exhausted with text still buffered
                 buf[r] += decoders[r].decode(b"", True)
@@ -542,25 +832,100 @@ class ApiState:
 def make_handler(state: ApiState):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # socket read/write timeout (satellite fix: the reference-shaped
+        # bug was a blocking read with no timeout wedging the server —
+        # socket.cpp; here a stalled peer costs one 408/disconnect, never
+        # a hung thread).  BaseRequestHandler.setup() applies it.
+        timeout = state.io_timeout if state.io_timeout > 0 else None
 
         def log_message(self, fmt, *a):
             print(f"🔷 {self.command} {self.path}")
 
-        def _json(self, code: int, obj: dict):
+        def send_response(self, *a, **kw):
+            self._began_response = True
+            super().send_response(*a, **kw)
+
+        def _json(self, code: int, obj: dict, headers: dict | None = None):
             data = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, str(v))
+            if state.draining:
+                # drain wants connection threads gone promptly, not
+                # parked in keep-alive reads until the io timeout
+                self.close_connection = True
             self.end_headers()
-            self.wfile.write(data)
+            try:
+                self.wfile.write(data)
+            except OSError:
+                self.close_connection = True
 
-        def _completions(self):
+        def _safe_write(self, data: bytes, aborted: list) -> None:
+            """Stream-tail write that treats a dead client as abort, not
+            as an unhandled thread exception."""
+            if aborted[0]:
+                return
+            try:
+                self.wfile.write(data)
+                self.wfile.flush()
+            except OSError:
+                aborted[0] = True
+                state.metrics.bump("client_disconnects")
+
+        def _maybe_500(self, err: Exception) -> None:
+            """Answer 500 if no response has started (a mid-stream failure
+            already has its own SSE error-event path)."""
+            if getattr(self, "_began_response", False):
+                return
+            try:
+                self._json(500, {"error": {"message": str(err),
+                                           "type": "server_error"}})
+            except OSError:
+                pass
+
+        def _read_body(self) -> dict | None:
+            """Read + parse the JSON body.  Returns None when a response
+            (408/400/413) was already sent or the client vanished.  The
+            ``server.read_body`` fault point stands in for a stalled
+            client (a delay outlasting ``--io-timeout``, or
+            ``raise:TimeoutError`` directly)."""
+            try:
+                FAULTS.fire("server.read_body")
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                if length > MAX_BODY_BYTES:
+                    self.close_connection = True
+                    self._json(413, {"error": "request body too large"})
+                    return None
+                raw = self.rfile.read(length) if length > 0 else b""
+                if len(raw) < length:  # peer closed mid-body
+                    state.metrics.bump("client_disconnects")
+                    self.close_connection = True
+                    return None
+            except TimeoutError:  # socket.timeout alias: stalled client
+                state.metrics.bump("read_timeouts_408")
+                self.close_connection = True
+                self._json(408, {"error": "timed out reading request body"})
+                return None
+            except (TypeError, ValueError):
+                self._json(400, {"error": "bad Content-Length"})
+                return None
+            try:
+                body = json.loads(raw or b"{}")
+            except json.JSONDecodeError as e:
+                self._json(400, {"error": f"bad request: {e}"})
+                return None
+            if not isinstance(body, dict):
+                self._json(400, {"error": "request body must be a JSON object"})
+                return None
+            return body
+
+        def _completions(self, body: dict, deadline: float | None):
             """OpenAI text-completion endpoint; ``prompt`` may be a list
             and ``n`` replicates each prompt — every resulting row decodes
             as a distinct stream in one lockstep batch."""
             try:
-                length = int(self.headers.get("Content-Length", 0))
-                body = json.loads(self.rfile.read(length) or b"{}")
                 prompt = body.get("prompt")
                 prompts = [str(p) for p in prompt] if isinstance(prompt, list) \
                     else [str(prompt or "")]
@@ -622,20 +987,36 @@ def make_handler(state: ApiState):
                 self.send_header("Connection", "close")
                 self.end_headers()
 
+                aborted = [False]
+
                 def emit(idx, delta, finish):
-                    chunk = {"id": cid, "object": "text_completion",
-                             "created": created, "model": state.model_name,
-                             "choices": [{"text": delta, "index": idx,
-                                          "finish_reason": finish,
-                                          "logprobs": None}]}
-                    self.wfile.write(f"data: {json.dumps(chunk)}\n\n".encode())
-                    self.wfile.flush()
+                    # a dead client mid-stream flips `aborted`; the batch
+                    # loop polls it (is_aborted) and stops decoding at the
+                    # next chunk instead of generating into a broken pipe
+                    if aborted[0]:
+                        return
+                    try:
+                        FAULTS.fire("server.emit_delta")
+                        chunk = {"id": cid, "object": "text_completion",
+                                 "created": created, "model": state.model_name,
+                                 "choices": [{"text": delta, "index": idx,
+                                              "finish_reason": finish,
+                                              "logprobs": None}]}
+                        self.wfile.write(
+                            f"data: {json.dumps(chunk)}\n\n".encode())
+                        self.wfile.flush()
+                        if finish == "timeout":
+                            state.metrics.bump("deadline_timeouts")
+                    except OSError:
+                        aborted[0] = True
+                        state.metrics.bump("client_disconnects")
 
                 try:
                     state.complete_batch_stream(
                         prompts, temperature=temperature, top_p=top_p,
                         max_tokens=max_tokens, seed=seed, stop=stop,
-                        emit=emit, plan=plan)
+                        emit=emit, plan=plan, deadline=deadline,
+                        is_aborted=lambda: aborted[0])
                 except Exception as e:
                     # mid-stream failure: an OpenAI-shaped error event so
                     # clients can tell a died stream from a short success,
@@ -645,23 +1026,23 @@ def make_handler(state: ApiState):
                                      "type": "invalid_request_error"
                                      if isinstance(e, ContextOverflow)
                                      else "server_error"}}
-                    self.wfile.write(f"data: {json.dumps(err)}\n\n".encode())
-                    self.wfile.write(b"data: [DONE]\n\n")
-                    self.wfile.flush()
+                    self._safe_write(f"data: {json.dumps(err)}\n\n".encode()
+                                     + b"data: [DONE]\n\n", aborted)
                     if not isinstance(e, ContextOverflow):
                         raise
                     return
-                self.wfile.write(b"data: [DONE]\n\n")
-                self.wfile.flush()
+                self._safe_write(b"data: [DONE]\n\n", aborted)
                 return
             try:
                 choices, n_prompt, n_completion = state.complete_batch(
                     prompts, temperature=temperature, top_p=top_p,
                     max_tokens=max_tokens, seed=seed, stop=stop, echo=echo,
-                    logprobs=logprobs)
+                    logprobs=logprobs, deadline=deadline)
             except ContextOverflow as e:
                 self._json(400, {"error": str(e)})
                 return
+            if any(c["finish_reason"] == "timeout" for c in choices):
+                state.metrics.bump("deadline_timeouts")
             self._json(200, {
                 "id": cid,
                 "object": "text_completion", "created": created,
@@ -676,25 +1057,71 @@ def make_handler(state: ApiState):
                     "id": state.model_name, "object": "model",
                     "created": int(time.time()), "owned_by": "user"}]})
             elif self.path in ("/health", "/healthz"):
-                self._json(200, {"status": "ok"})
+                # liveness probes keep getting a 200 during drain (the
+                # process IS alive); orchestrators read "status"/"ready"
+                # for the readiness decision
+                self._json(200, state.health())
+            elif self.path == "/metrics":
+                self._json(200, state.metrics.snapshot())
             else:
                 self._json(404, {"error": "not found"})
 
         def do_POST(self):
-            if self.path == "/v1/completions":
-                self._completions()
-                return
-            if self.path != "/v1/chat/completions":
+            if self.path not in ("/v1/chat/completions", "/v1/completions"):
                 self._json(404, {"error": "not found"})
                 return
+            body = self._read_body()
+            if body is None:
+                return
+            verdict = state.try_enter()
+            if verdict == "draining":
+                state.metrics.bump("requests_rejected_503")
+                self._json(503, {"error": "server is draining; "
+                                          "no new requests accepted"},
+                           headers={"Retry-After": 30})
+                return
+            if verdict == "full":
+                state.metrics.bump("requests_rejected_429")
+                self._json(429, {"error": f"server at capacity "
+                                          f"({state.max_pending} requests "
+                                          "pending); retry later"},
+                           headers={"Retry-After": state.retry_after_hint()})
+                return
+            t0 = time.monotonic()
+            deadline = state.request_deadline(body)
             try:
-                length = int(self.headers.get("Content-Length", 0))
-                body = json.loads(self.rfile.read(length) or b"{}")
-                params = parse_request(body, state.default_temperature, state.default_topp)
+                # THE engine mutex: one generation at a time per KV cache;
+                # the wait here IS the admission queue try_enter bounded
+                with state.engine_lock:
+                    state.mark_active(True)
+                    try:
+                        if self.path == "/v1/completions":
+                            self._completions(body, deadline)
+                        else:
+                            self._chat(body, deadline)
+                    finally:
+                        state.mark_active(False)
+                state.metrics.bump("requests_served")
+            except (BrokenPipeError, ConnectionResetError):
+                # client gone between chunks with nothing left to send;
+                # generation already stopped via the abort flag
+                state.metrics.bump("client_disconnects")
+                self.close_connection = True
+            except Exception as e:
+                state.metrics.bump("server_errors")
+                self._maybe_500(e)
+                raise  # surface in the server log — a 500 is a bug to fix
+            finally:
+                state.leave(time.monotonic() - t0)
+
+        def _chat(self, body: dict, deadline: float | None):
+            try:
+                params = parse_request(body, state.default_temperature,
+                                       state.default_topp)
                 if not params.messages:
                     self._json(400, {"error": "messages required"})
                     return
-            except (TypeError, ValueError, json.JSONDecodeError) as e:
+            except (TypeError, ValueError) as e:
                 self._json(400, {"error": f"bad request: {e}"})
                 return
 
@@ -712,10 +1139,13 @@ def make_handler(state: ApiState):
                                               "--batch-slots N"})
                     return
                 try:
-                    n_choices, n_prompt, n_completion = state.complete_n(params)
+                    n_choices, n_prompt, n_completion = state.complete_n(
+                        params, deadline=deadline)
                 except ContextOverflow as e:
                     self._json(400, {"error": str(e)})
                     return
+                if any(fin == "timeout" for _, fin in n_choices):
+                    state.metrics.bump("deadline_timeouts")
                 self._json(200, {
                     "id": cid, "object": "chat.completion", "created": created,
                     "model": state.model_name,
@@ -733,16 +1163,32 @@ def make_handler(state: ApiState):
                 self.send_header("Connection", "close")
                 self.end_headers()
 
+                aborted = [False]
+
                 def emit(delta):
-                    chunk = {"id": cid, "object": "chat.completion.chunk",
-                             "created": created, "model": state.model_name,
-                             "choices": [{"index": 0, "delta": {"content": delta},
-                                          "finish_reason": None}]}
-                    self.wfile.write(f"data: {json.dumps(chunk)}\n\n".encode())
-                    self.wfile.flush()
+                    # a dead client sets `aborted`; complete() polls it
+                    # between chunks (is_aborted) and ends the stream via
+                    # drain_generation's normal pos-rewind path
+                    if aborted[0]:
+                        return
+                    try:
+                        FAULTS.fire("server.emit_delta")
+                        chunk = {"id": cid, "object": "chat.completion.chunk",
+                                 "created": created, "model": state.model_name,
+                                 "choices": [{"index": 0,
+                                              "delta": {"content": delta},
+                                              "finish_reason": None}]}
+                        self.wfile.write(
+                            f"data: {json.dumps(chunk)}\n\n".encode())
+                        self.wfile.flush()
+                    except OSError:
+                        aborted[0] = True
+                        state.metrics.bump("client_disconnects")
 
                 try:
-                    state.complete(params, emit)
+                    _, _, _, finish = state.complete(
+                        params, emit, deadline=deadline,
+                        is_aborted=lambda: aborted[0])
                 except ContextOverflow as e:
                     # headers already sent: emit an OpenAI-shaped error
                     # object and terminate WITHOUT a normal finish chunk, so
@@ -752,26 +1198,32 @@ def make_handler(state: ApiState):
                     # (ADVICE r01: a bare ValueError catch masked bugs).
                     err = {"error": {"message": str(e),
                                      "type": "invalid_request_error"}}
-                    self.wfile.write(f"data: {json.dumps(err)}\n\n".encode())
-                    self.wfile.write(b"data: [DONE]\n\n")
-                    self.wfile.flush()
+                    self._safe_write(f"data: {json.dumps(err)}\n\n".encode()
+                                     + b"data: [DONE]\n\n", aborted)
                     return
+                if finish == "aborted" or aborted[0]:
+                    return  # nobody is listening; engine state is rewound
+                if finish == "timeout":
+                    state.metrics.bump("deadline_timeouts")
                 final = {"id": cid, "object": "chat.completion.chunk",
                          "created": created, "model": state.model_name,
-                         "choices": [{"index": 0, "delta": {}, "finish_reason": "stop"}]}
-                self.wfile.write(f"data: {json.dumps(final)}\n\n".encode())
-                self.wfile.write(b"data: [DONE]\n\n")
-                self.wfile.flush()
+                         "choices": [{"index": 0, "delta": {},
+                                      "finish_reason": finish}]}
+                self._safe_write(f"data: {json.dumps(final)}\n\n".encode()
+                                 + b"data: [DONE]\n\n", aborted)
             else:
                 try:
-                    reply, n_prompt, n_completion = state.complete(params, lambda d: None)
+                    reply, n_prompt, n_completion, finish = state.complete(
+                        params, lambda d: None, deadline=deadline)
                 except ContextOverflow as e:
                     self._json(400, {"error": str(e)})
                     return
+                if finish == "timeout":
+                    state.metrics.bump("deadline_timeouts")
                 self._json(200, {
                     "id": cid, "object": "chat.completion", "created": created,
                     "model": state.model_name,
-                    "choices": [{"index": 0, "finish_reason": "stop",
+                    "choices": [{"index": 0, "finish_reason": finish,
                                  "message": {"role": "assistant", "content": reply}}],
                     "usage": {"prompt_tokens": n_prompt,
                               "completion_tokens": n_completion,
@@ -780,10 +1232,58 @@ def make_handler(state: ApiState):
     return Handler
 
 
-def serve(state: ApiState, host: str = "0.0.0.0", port: int = 9990):
-    server = HTTPServer((host, port), make_handler(state))
+class ApiServer(ThreadingHTTPServer):
+    """Threaded HTTP server wired for graceful drain: non-daemon handler
+    threads + ``block_on_close`` make ``shutdown()`` wait for in-flight
+    requests (each bounded by the drain deadline), and ``allow_reuse_address``
+    lets a restart rebind the port while old sockets linger in TIME_WAIT."""
+    daemon_threads = False
+    block_on_close = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, handler, state: ApiState):
+        self.state = state
+        super().__init__(addr, handler)
+
+
+def serve(state: ApiState, host: str = "0.0.0.0", port: int = 9990, *,
+          block: bool = True, install_signals: bool | None = None
+          ) -> ApiServer:
+    """Bind and serve.  Returns the server object; with ``block=False`` it
+    serves on a background thread (tests drive requests and then call
+    ``server.shutdown()`` themselves).
+
+    Graceful drain (satellite + tentpole contract): SIGTERM/SIGINT flips
+    the state into draining — new requests get 503, every in-flight
+    deadline is clamped to now + ``--drain-grace`` — then ``shutdown()``
+    runs from a helper thread (calling it from the signal frame inside
+    ``serve_forever`` would deadlock on its own event).  A second signal
+    hard-exits."""
+    server = ApiServer((host, port), make_handler(state), state)
+    if install_signals is None:
+        install_signals = block and \
+            threading.current_thread() is threading.main_thread()
+    if install_signals:
+        def _drain(signum, frame):
+            if state.draining:  # second signal: operator means NOW
+                os._exit(1)
+            state.begin_drain()
+            print(f"🔷 {signal.Signals(signum).name}: draining "
+                  f"(grace {state.drain_grace:.0f}s)")
+            threading.Thread(target=server.shutdown, daemon=True).start()
+        signal.signal(signal.SIGTERM, _drain)
+        signal.signal(signal.SIGINT, _drain)
     print(f"🔷 dllama-api listening on {host}:{port}")
-    server.serve_forever()
+    if block:
+        try:
+            server.serve_forever()
+        finally:
+            server.server_close()
+        print("🔷 drained; bye")
+    else:
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+    return server
 
 
 def main(argv=None):
@@ -808,12 +1308,17 @@ def main(argv=None):
         # allocated (see ApiState docstring)
         batch_engine = Engine(engine.cfg, engine.params, mesh=engine.mesh,
                               batch=args.batch_slots, seq_len=args.max_seq_len,
-                              kv_dtype=engine.cache.k.dtype)
+                              kv_dtype=engine.cache.k.dtype,
+                              step_timeout=args.step_timeout)
         print(f"🔷 batched /v1/completions: {args.batch_slots} lockstep slots")
     state = ApiState(engine, tok, default_temperature=args.temperature,
                      default_topp=args.topp, chunk=args.chunk,
-                     batch_engine=batch_engine)
-    serve(state, port=args.port)
+                     batch_engine=batch_engine,
+                     max_pending=args.max_pending,
+                     request_timeout=args.request_timeout,
+                     io_timeout=args.io_timeout,
+                     drain_grace=args.drain_grace)
+    serve(state, host=args.host, port=args.port)
 
 
 if __name__ == "__main__":

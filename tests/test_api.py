@@ -389,10 +389,12 @@ def test_completions_echo_empty_completion_logprobs(tmp_path, monkeypatch):
     state = ApiState(eng, tok, batch_engine=eng)
     eos = tok.eos_id
 
-    def eos_first(id_lists, budget, **kw):  # every row: EOS immediately
-        return [list(ids) + [eos] for ids in id_lists]
+    import numpy as np
 
-    monkeypatch.setattr(eng, "generate_batch", eos_first)
+    def eos_first(id_lists, budget, **kw):  # every row: EOS immediately
+        yield np.array([eos] * len(id_lists))
+
+    monkeypatch.setattr(eng, "generate_batch_stream", eos_first)
     kw = dict(temperature=0.0, top_p=1.0, max_tokens=4, seed=1, stop=[])
 
     choices, _, n_completion = state.complete_batch(

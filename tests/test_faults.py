@@ -1,0 +1,412 @@
+"""Fault-injection harness + serving fault-tolerance tests.
+
+The reference's degraded modes (stalled sockets, dead clients, a
+coordinator that is not up yet) are only ever exercised by production
+incidents — socket.cpp has no test for any of them.  Here every one is a
+deterministic test: the fault registry (runtime/faults.py) arms named
+fault points in the real serving stack and the assertions run against a
+live in-process server (plus one real-SIGTERM subprocess drill).
+
+Covers the acceptance contract: disconnect mid-SSE rewinds ``engine.pos``
+and the server keeps serving; a deadline expiry returns a well-formed
+truncated completion with ``finish_reason="timeout"``; a full admission
+queue answers 429 + Retry-After; SIGTERM drains in-flight work; and
+``init_distributed`` retries through injected coordinator failures.
+"""
+
+import json
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from fixtures import REPO, cpu_env, free_port, write_tiny_model, write_tiny_tokenizer
+from dllama_tpu.runtime.faults import (
+    FAULTS, Fault, FaultInjected, FaultRegistry, injected, parse_spec)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """No fault leaks between tests (the registry is process-global)."""
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+# --- unit: spec grammar ---
+
+def test_parse_spec_full_entry():
+    (f,) = parse_spec("engine.device_step=delay:0.5@2x3")
+    assert (f.point, f.action, f.arg) == ("engine.device_step", "delay", "0.5")
+    assert (f.skip, f.times) == (2, 3)
+
+
+def test_parse_spec_multiple_and_defaults():
+    a, b = parse_spec("server.emit_delta=disconnect, p.q=raise:ConnectionError@1x2")
+    assert (a.action, a.arg, a.skip, a.times) == ("disconnect", None, 0, None)
+    assert (b.action, b.arg, b.skip, b.times) == ("raise", "ConnectionError", 1, 2)
+
+
+def test_parse_spec_rejects_malformed():
+    for bad in ("nope", "p=explode", "p=raise:NoSuchError", "p=delay@x"):
+        with pytest.raises(ValueError, match="bad fault entry"):
+            parse_spec(bad)
+
+
+# --- unit: registry windows + actions ---
+
+def test_firing_window_skip_and_times():
+    reg = FaultRegistry()
+    reg.install("p=delay:0@1x2")  # dormant hit 1, fires hits 2-3, dormant after
+    for _ in range(5):
+        reg.fire("p")
+    (f,) = reg.snapshot()
+    assert f.hits == 5 and f.fired == 2
+
+
+def test_raise_action_and_injected_scope():
+    with injected("p=raise:ConnectionError:boom"):
+        with pytest.raises(ConnectionError, match="boom"):
+            FAULTS.fire("p")
+    FAULTS.fire("p")  # disarmed on exit: no-op
+    assert not FAULTS.active()
+
+
+def test_default_raise_is_fault_injected():
+    with injected("p=raise"):
+        with pytest.raises(FaultInjected):
+            FAULTS.fire("p")
+
+
+def test_nan_action_returned_to_call_site():
+    reg = FaultRegistry()
+    reg.install(Fault("p", "nan"))
+    assert reg.fire("p") == ["nan"]
+    assert reg.fire("other") == []
+
+
+def test_install_env():
+    reg = FaultRegistry()
+    assert not reg.install_env({"NOT_THE_VAR": "p=nan"})
+    assert reg.install_env({"DLLAMA_FAULTS": "p=nan"})
+    assert reg.fire("p") == ["nan"]
+
+
+# --- unit: init_distributed retry/backoff ---
+
+def _fake_distributed(monkeypatch, proc_id=0):
+    import jax
+
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    monkeypatch.setattr(jax, "process_index", lambda: proc_id)
+    return calls
+
+
+def test_init_distributed_retries_through_injected_failures(monkeypatch):
+    from dllama_tpu.parallel.distributed import init_distributed
+
+    calls = _fake_distributed(monkeypatch, proc_id=1)
+    with injected("distributed.initialize=raise:ConnectionErrorx2"):
+        t0 = time.monotonic()
+        assert init_distributed("127.0.0.1:1234", 2, 1,
+                                max_retries=5, backoff=0.01) == 1
+        (f,) = FAULTS.snapshot()
+    assert f.fired == 2          # two coordinator failures before success
+    assert len(calls) == 1       # real init reached exactly once
+    assert time.monotonic() - t0 >= 0.01 + 0.02  # exponential backoff slept
+
+
+def test_init_distributed_gives_up_after_max_retries(monkeypatch):
+    from dllama_tpu.parallel.distributed import init_distributed
+
+    calls = _fake_distributed(monkeypatch)
+    with injected("distributed.initialize=raise:ConnectionError"):
+        with pytest.raises(ConnectionError):
+            init_distributed("127.0.0.1:1234", 2, 0,
+                             max_retries=1, backoff=0.01)
+    assert calls == []  # every attempt failed at the (injected) connect
+
+
+def test_init_distributed_bad_args_never_retry():
+    from dllama_tpu.parallel.distributed import init_distributed
+
+    t0 = time.monotonic()
+    with pytest.raises(ValueError, match="--proc-id"):
+        init_distributed("127.0.0.1:1234", 2, None, backoff=5.0)
+    assert time.monotonic() - t0 < 1.0  # fail-fast, no backoff sleep
+
+
+# --- engine: watchdog + nan at the sync seam ---
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    import jax
+
+    from dllama_tpu.models.config import tiny_config
+    from dllama_tpu.models.params import init_params
+    from dllama_tpu.parallel.mesh import make_mesh
+    from dllama_tpu.runtime.engine import Engine
+    from dllama_tpu.tokenizer.bpe import Tokenizer
+
+    d = tmp_path_factory.mktemp("faults")
+    tok = Tokenizer(write_tiny_tokenizer(str(d / "tok.t")))
+    cfg = tiny_config(seq_len=128, vocab_size=300)
+    eng = Engine(cfg, init_params(cfg, seed=4),
+                 mesh=make_mesh(tp=1, devices=jax.devices()[:1]))
+    return eng, tok
+
+
+def test_step_timeout_watchdog(stack):
+    import numpy as np
+
+    from dllama_tpu.runtime.engine import StepTimeout
+
+    eng, _ = stack
+    eng.step_timeout = 0.2
+    try:
+        with injected("engine.device_step=delay:3"):
+            with pytest.raises(StepTimeout, match="pos="):
+                eng._sync(np.zeros(2), "probe step")
+    finally:
+        eng.step_timeout = None
+
+
+def test_sync_reports_nan_action(stack):
+    import numpy as np
+
+    eng, _ = stack
+    with injected("engine.device_step=nan"):
+        assert eng._sync(np.zeros(2), "probe step") == ["nan"]
+    assert eng._sync(np.zeros(2), "probe step") == []
+
+
+# --- live in-process server ---
+
+@pytest.fixture
+def api(stack):
+    from dllama_tpu.server.api import ApiState, serve
+
+    servers = []
+
+    def make(**kw):
+        eng, tok = stack
+        state = ApiState(eng, tok, default_temperature=0.0, chunk=2, **kw)
+        srv = serve(state, host="127.0.0.1", port=free_port(), block=False)
+        servers.append(srv)
+        return state, f"http://127.0.0.1:{srv.server_address[1]}"
+
+    yield make
+    for s in servers:
+        s.shutdown()
+        s.server_close()
+
+
+def post(base, path, body, timeout=240):
+    req = urllib.request.Request(
+        base + path, json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def get(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        return json.loads(r.read())
+
+
+CHAT = "/v1/chat/completions"
+BODY = {"messages": [{"role": "user", "content": "hello"}], "seed": 3}
+
+
+def _wait_active(state, n=1, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if state.queue_depths()[0] >= n:
+            return
+        time.sleep(0.01)
+    pytest.fail("request never became active")
+
+
+def _wait_idle(state, timeout=10.0):
+    """The admission slot frees a beat AFTER the client has its response
+    (the handler thread still has to run its accounting) — wait it out
+    before a test that needs the next request to own the queue."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if state.queue_depths() == (0, 0):
+            return
+        time.sleep(0.005)
+    pytest.fail("server never went idle")
+
+
+def test_health_is_enriched_and_metrics_export(api):
+    state, base = api(max_pending=5)
+    h = get(base, "/health")
+    assert h["status"] == "ok" and h["ready"] is True
+    assert h["backend"] == "cpu" and h["mesh"].get("tp") == 1
+    assert (h["in_flight"], h["queued"], h["max_pending"]) == (0, 0, 5)
+    assert h["seq_len"] == 128 and h["uptime_s"] >= 0
+    m = get(base, "/metrics")
+    for k in ("requests_served", "requests_rejected_429", "deadline_timeouts",
+              "client_disconnects", "read_timeouts_408", "avg_request_s"):
+        assert k in m
+
+
+def test_disconnect_mid_stream_rewinds_pos_and_server_survives(api):
+    state, base = api()
+    eng = state.engine
+    body = dict(BODY, max_tokens=24, stream=True)
+    with injected("server.emit_delta=disconnect"):
+        with post(base, CHAT, body) as r:
+            raw = r.read()  # server aborts the stream; no terminator
+        assert b"[DONE]" not in raw
+        (f,) = FAULTS.snapshot()
+        assert f.fired >= 1, "the injected disconnect must actually fire"
+    # THE invariant: the cache's last entry records exactly the position
+    # the KV cache was rewound to (runtime/stream.py pos-rewind contract)
+    assert state.naive_cache.items
+    assert eng.pos == state.naive_cache.items[-1].end_pos
+    assert get(base, "/metrics")["client_disconnects"] >= 1
+    # and the server still serves: a fresh conversation works end to end
+    with post(base, CHAT, {"messages": [{"role": "user", "content": "again"}],
+                           "max_tokens": 4, "seed": 1}) as r:
+        data = json.loads(r.read())
+    assert data["choices"][0]["message"]["content"] is not None
+    assert data["choices"][0]["finish_reason"] == "stop"
+
+
+def test_deadline_expiry_returns_truncated_timeout_completion(api):
+    state, base = api()
+    with post(base, CHAT, dict(BODY, max_tokens=4)) as r:
+        json.loads(r.read())  # warm the compile caches off the clock
+    t0 = time.monotonic()
+    with injected("engine.device_step=delay:0.4"):
+        with post(base, CHAT, dict(BODY, max_tokens=32, timeout=0.6)) as r:
+            data = json.loads(r.read())
+    elapsed = time.monotonic() - t0
+    assert data["object"] == "chat.completion"  # well-formed OpenAI shape
+    assert data["choices"][0]["finish_reason"] == "timeout"
+    assert data["usage"]["completion_tokens"] >= 1  # truncated, not empty
+    # bounded: deadline + one in-flight chunk (+ slack for a slow box)
+    assert elapsed < 6.0
+    assert get(base, "/metrics")["deadline_timeouts"] >= 1
+
+
+def test_full_queue_answers_429_with_retry_after(api):
+    state, base = api(max_pending=1)
+    with post(base, CHAT, dict(BODY, max_tokens=2)) as r:
+        r.read()  # warm
+    _wait_idle(state)
+    results = {}
+    with injected("engine.device_step=delay:0.2"):
+        def slow():
+            with post(base, CHAT, dict(BODY, max_tokens=16)) as r:
+                results["slow"] = json.loads(r.read())
+        t = threading.Thread(target=slow)
+        t.start()
+        _wait_active(state)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(base, CHAT, dict(BODY, max_tokens=2))
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        t.join(120)
+    # the rejected request never disturbed the admitted one
+    assert results["slow"]["choices"][0]["message"]["content"] is not None
+    assert get(base, "/metrics")["requests_rejected_429"] >= 1
+
+
+def test_stalled_body_read_answers_408(api):
+    state, base = api()
+    with injected("server.read_body=raise:TimeoutError"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(base, CHAT, dict(BODY, max_tokens=2))
+    assert ei.value.code == 408
+    assert state.metrics.read_timeouts_408 == 1
+
+
+def test_drain_rejects_new_work_and_finishes_inflight(api):
+    state, base = api(drain_grace=60.0)
+    with post(base, CHAT, dict(BODY, max_tokens=2)) as r:
+        r.read()  # warm
+    _wait_idle(state)
+    results = {}
+    with injected("engine.device_step=delay:0.2"):
+        def slow():
+            with post(base, CHAT, dict(BODY, max_tokens=16)) as r:
+                results["slow"] = json.loads(r.read())
+        t = threading.Thread(target=slow)
+        t.start()
+        _wait_active(state)
+        state.begin_drain()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(base, CHAT, dict(BODY, max_tokens=2))
+        assert ei.value.code == 503
+        assert "Retry-After" in ei.value.headers
+        t.join(120)
+    # generous grace: the in-flight request ran to its natural finish
+    assert results["slow"]["choices"][0]["finish_reason"] == "stop"
+    assert get(base, "/health")["status"] == "draining"
+    assert state.metrics.requests_rejected_503 >= 1
+
+
+def test_sigterm_drains_inflight_then_exits_cleanly(tmp_path):
+    """Real-process drill: SIGTERM mid-request → the in-flight request
+    completes, new connections stop being served, exit code 0."""
+    m, t = str(tmp_path / "tiny.m"), str(tmp_path / "tiny.t")
+    write_tiny_model(m)
+    write_tiny_tokenizer(t)
+    port = free_port()
+    env = cpu_env()
+    # slow decode so the request is reliably in flight when SIGTERM lands
+    env["DLLAMA_FAULTS"] = "engine.device_step=delay:0.15"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dllama_tpu.server.api", "--model", m,
+         "--tokenizer", t, "--port", str(port), "--temperature", "0",
+         "--max-seq-len", "64", "--drain-grace", "60", "--io-timeout", "5"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        for _ in range(600):
+            if proc.poll() is not None:
+                raise RuntimeError(f"server died:\n{proc.stdout.read()}")
+            try:
+                urllib.request.urlopen(base + "/health", timeout=1)
+                break
+            except OSError:
+                time.sleep(0.2)
+        else:
+            raise RuntimeError("server did not come up")
+        results = {}
+
+        def slow():
+            with post(base, CHAT, dict(BODY, max_tokens=48)) as r:
+                results["slow"] = json.loads(r.read())
+
+        t_req = threading.Thread(target=slow)
+        t_req.start()
+        for _ in range(600):  # wait until the request is actually decoding
+            if get(base, "/health")["in_flight"] >= 1:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("request never became active")
+        proc.send_signal(signal.SIGTERM)
+        t_req.join(180)
+        assert not t_req.is_alive()
+        data = results["slow"]  # in-flight request finished, well-formed
+        assert data["choices"][0]["message"]["content"] is not None
+        assert data["choices"][0]["finish_reason"] in ("stop", "timeout")
+        assert proc.wait(timeout=120) == 0  # drained and exited cleanly
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()

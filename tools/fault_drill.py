@@ -1,0 +1,260 @@
+#!/usr/bin/env python
+"""End-to-end fault drill against a REAL api-server process.
+
+Starts ``python -m dllama_tpu.server.api`` on a tiny synthetic model with
+a ``DLLAMA_FAULTS`` spec armed, fires real HTTP requests at it, and
+asserts the endpoint-level contract for each degraded mode
+(docs/ROBUSTNESS.md).  This is the out-of-process complement to
+tests/test_faults.py: everything here crosses a real socket to a real
+server under an injected fault, the way an operator would smoke-test a
+deployment.
+
+Usage::
+
+    python tools/fault_drill.py                  # run every drill
+    python tools/fault_drill.py deadline drain   # just these
+    python tools/fault_drill.py --list
+
+Each drill prints PASS/FAIL; exit code 0 iff all passed.  CPU-only and
+tier-1-fast — the model is the tests' tiny fixture, written to a temp
+dir.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # dllama_tpu (running from a checkout)
+sys.path.insert(0, os.path.join(REPO, "tests"))  # the tiny-model fixtures
+
+CHAT = "/v1/chat/completions"
+BODY = {"messages": [{"role": "user", "content": "hello"}],
+        "seed": 3, "max_tokens": 8}
+
+
+def post(base: str, body: dict, timeout: float = 240.0):
+    req = urllib.request.Request(
+        base + CHAT, json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def get(base: str, path: str) -> dict:
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        return json.loads(r.read())
+
+
+class Server:
+    """One api-server subprocess on the tiny fixture model."""
+
+    def __init__(self, model: str, tokenizer: str, *, faults: str = "",
+                 extra_flags: list[str] | None = None):
+        from fixtures import cpu_env, free_port
+        self.port = free_port()
+        self.base = f"http://127.0.0.1:{self.port}"
+        env = cpu_env()
+        if faults:
+            env["DLLAMA_FAULTS"] = faults
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "dllama_tpu.server.api",
+             "--model", model, "--tokenizer", tokenizer,
+             "--port", str(self.port), "--temperature", "0",
+             "--max-seq-len", "64", *(extra_flags or [])],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+
+    def wait_ready(self, timeout: float = 120.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(f"server died:\n{self.proc.stdout.read()}")
+            try:
+                urllib.request.urlopen(self.base + "/health", timeout=1)
+                return
+            except OSError:
+                time.sleep(0.2)
+        raise RuntimeError("server did not come up")
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+        self.proc.wait()
+
+
+# --- drills ---------------------------------------------------------------
+
+def drill_deadline(model, tok):
+    """Slow device steps + a 1s request deadline → finish_reason="timeout"
+    within the deadline plus one chunk."""
+    s = Server(model, tok, faults="engine.device_step=delay:0.4")
+    try:
+        s.wait_ready()
+        t0 = time.monotonic()
+        with post(s.base, dict(BODY, max_tokens=32, timeout=1.0)) as r:
+            data = json.loads(r.read())
+        elapsed = time.monotonic() - t0
+        assert data["choices"][0]["finish_reason"] == "timeout", data
+        assert data["usage"]["completion_tokens"] >= 1, data
+        assert elapsed < 30.0, f"unbounded: {elapsed:.1f}s"  # compile + slack
+        assert get(s.base, "/metrics")["deadline_timeouts"] >= 1
+    finally:
+        s.stop()
+
+
+def drill_disconnect(model, tok):
+    """Injected mid-SSE disconnect → the server logs the disconnect and the
+    NEXT request over a fresh connection serves normally."""
+    s = Server(model, tok, faults="server.emit_delta=disconnectx1")
+    try:
+        s.wait_ready()
+        with post(s.base, dict(BODY, stream=True)) as r:
+            raw = r.read()
+        assert b"[DONE]" not in raw, "stream must abort, not terminate"
+        with post(s.base, dict(BODY)) as r:
+            data = json.loads(r.read())
+        assert data["choices"][0]["finish_reason"] == "stop", data
+        assert get(s.base, "/metrics")["client_disconnects"] >= 1
+    finally:
+        s.stop()
+
+
+def drill_read_timeout(model, tok):
+    """Stalled body read → 408 and the connection is closed."""
+    s = Server(model, tok, faults="server.read_body=raise:TimeoutErrorx1")
+    try:
+        s.wait_ready()
+        try:
+            post(s.base, BODY)
+            raise AssertionError("expected 408")
+        except urllib.error.HTTPError as e:
+            assert e.code == 408, e.code
+        with post(s.base, BODY) as r:  # next request unaffected
+            json.loads(r.read())
+        assert get(s.base, "/metrics")["read_timeouts_408"] == 1
+    finally:
+        s.stop()
+
+
+def drill_backpressure(model, tok):
+    """--max-pending 1 + slow decode → concurrent request gets 429 with an
+    honest Retry-After, and the admitted request is undisturbed."""
+    s = Server(model, tok, faults="engine.device_step=delay:0.2",
+               extra_flags=["--max-pending", "1"])
+    try:
+        s.wait_ready()
+        results: dict = {}
+
+        def slow():
+            with post(s.base, dict(BODY, max_tokens=48)) as r:
+                results["slow"] = json.loads(r.read())
+
+        t = threading.Thread(target=slow)
+        t.start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:  # wait until it is decoding
+            if get(s.base, "/health")["in_flight"] >= 1:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("request never became active")
+        try:
+            post(s.base, dict(BODY, max_tokens=2))
+            raise AssertionError("expected 429")
+        except urllib.error.HTTPError as e:
+            assert e.code == 429, e.code
+            assert int(e.headers["Retry-After"]) >= 1
+        t.join(180)
+        assert results["slow"]["choices"][0]["finish_reason"] == "stop"
+    finally:
+        s.stop()
+
+
+def drill_drain(model, tok):
+    """SIGTERM mid-request → in-flight request completes, process exits 0."""
+    s = Server(model, tok, faults="engine.device_step=delay:0.15",
+               extra_flags=["--drain-grace", "60", "--io-timeout", "5"])
+    try:
+        s.wait_ready()
+        results: dict = {}
+
+        def slow():
+            with post(s.base, dict(BODY, max_tokens=48)) as r:
+                results["slow"] = json.loads(r.read())
+
+        t = threading.Thread(target=slow)
+        t.start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if get(s.base, "/health")["in_flight"] >= 1:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("request never became active")
+        s.proc.send_signal(signal.SIGTERM)
+        t.join(180)
+        assert results["slow"]["choices"][0]["finish_reason"] in (
+            "stop", "timeout"), results
+        assert s.proc.wait(timeout=120) == 0, "drain must exit cleanly"
+    finally:
+        s.stop()
+
+
+DRILLS = {
+    "deadline": drill_deadline,
+    "disconnect": drill_disconnect,
+    "read_timeout": drill_read_timeout,
+    "backpressure": drill_backpressure,
+    "drain": drill_drain,
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("drills", nargs="*",
+                    help=f"subset to run (default: all of "
+                         f"{', '.join(DRILLS)})")
+    ap.add_argument("--list", action="store_true")
+    args = ap.parse_args(argv)
+    unknown = [d for d in args.drills if d not in DRILLS]
+    if unknown:
+        ap.error(f"unknown drill(s): {', '.join(unknown)} "
+                 f"(choose from {', '.join(DRILLS)})")
+    if args.list:
+        for name, fn in DRILLS.items():
+            print(f"{name:14s} {fn.__doc__.splitlines()[0]}")
+        return 0
+    from fixtures import write_tiny_model, write_tiny_tokenizer
+    names = args.drills or list(DRILLS)
+    failed = []
+    with tempfile.TemporaryDirectory() as d:
+        model, tok = os.path.join(d, "tiny.m"), os.path.join(d, "tiny.t")
+        write_tiny_model(model)
+        write_tiny_tokenizer(tok)
+        for name in names:
+            t0 = time.monotonic()
+            try:
+                DRILLS[name](model, tok)
+                print(f"✅ {name} ({time.monotonic() - t0:.1f}s)")
+            except Exception as e:
+                failed.append(name)
+                print(f"❌ {name}: {e}")
+    if failed:
+        print(f"{len(failed)}/{len(names)} drills failed: {', '.join(failed)}")
+        return 1
+    print(f"all {len(names)} drills passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
