@@ -164,6 +164,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "StepTimeout (default DLLAMA_STEP_TIMEOUT or none); "
                         "turns a silently hung device into a diagnosable "
                         "error naming the step, position and mesh")
+    # ---- artifact integrity / state recovery (docs/ROBUSTNESS.md) ----
+    p.add_argument("--verify-weights", action="store_true",
+                   help="verify each tensor's crc32 against the model's "
+                        "sidecar checksum manifest (<model>.m.sum, written "
+                        "by tools/checksum_model.py) on first read; the "
+                        "header digest is always verified when the manifest "
+                        "exists.  Fails fast with ArtifactError on any "
+                        "corruption instead of decoding garbage")
+    p.add_argument("--numeric-checks", action="store_true",
+                   help="check host-fetched logits for NaN/Inf every step "
+                        "and raise NumericFault (step, pos) instead of "
+                        "emitting garbage tokens (default "
+                        "DLLAMA_NUMERIC_CHECKS)")
+    p.add_argument("--snapshot-dir", default=None,
+                   help="api server: directory for engine-state snapshots; "
+                        "on SIGTERM drain the KV cache/position/RNG persist "
+                        "here and the next boot warm-starts from it "
+                        "(validated: a corrupt or mismatched snapshot "
+                        "cold-starts with a logged reason)")
     return p
 
 
@@ -172,7 +191,8 @@ def load_stack(args, batch: int | None = None) -> tuple[Engine, Tokenizer]:
     if not args.model or not args.tokenizer:
         raise SystemExit("--model and --tokenizer are required for this mode")
     wft = quants.FLOAT_TYPE_BY_NAME[args.weights_float_type] if args.weights_float_type else None
-    mf = mfile.MFile(args.model, weights_ftype=wft)
+    mf = mfile.MFile(args.model, weights_ftype=wft,
+                     verify=getattr(args, "verify_weights", False))
     bft = args.buffer_float_type
     if bft == "q80":
         print("💡 bufferFloatType q80 → bf16 (activations stay on-chip; Q80's "
@@ -196,7 +216,11 @@ def load_stack(args, batch: int | None = None) -> tuple[Engine, Tokenizer]:
                 if args.kv_cache_dtype else None)
     engine = Engine(cfg, params, mesh=mesh, seq_len=args.max_seq_len,
                     kv_dtype=kv_dtype, batch=batch or max(args.dp, 1),
-                    step_timeout=getattr(args, "step_timeout", None))
+                    step_timeout=getattr(args, "step_timeout", None),
+                    # flag turns checks ON; absent → None keeps the
+                    # DLLAMA_NUMERIC_CHECKS env default
+                    numeric_checks=(True if getattr(args, "numeric_checks",
+                                                    False) else None))
     tok = Tokenizer(tfile.read_tfile(args.tokenizer))
     if tok.vocab_size != cfg.vocab_size:
         raise SystemExit("tokenizer is incompatible with model (vocab size mismatch)")

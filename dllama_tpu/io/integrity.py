@@ -1,0 +1,264 @@
+"""Artifact integrity: typed loader errors + checksum manifests.
+
+The reference engine mmaps whatever bytes it is handed
+(``loadSpecFromFile``, transformer.cpp:12-125, does no bounds or
+integrity checking), so a truncated or bit-flipped model file surfaces
+as a cryptic ``struct.error``, a silently-garbage tensor, or NaN logits
+minutes into decode.  This module is the common substrate for the
+validated loaders (io/mfile.py, io/tfile.py) and the engine snapshot
+format (runtime/snapshot.py):
+
+* :class:`ArtifactError` — THE corruption exception.  Every loader-level
+  failure names the file, the field being parsed, the byte offset, and
+  expected-vs-got, so a bad artifact is diagnosable from the message
+  alone.  Subclasses ``ValueError`` so pre-existing callers that caught
+  ValueError keep working.
+* **Checksum manifests** — a JSON sidecar (``<model>.m.sum``) carrying a
+  crc32 per tensor byte-range plus a header digest, written by
+  ``tools/checksum_model.py``.  ``MFile`` verifies the header digest
+  always (when the sidecar exists) and tensor digests lazily on first
+  read under ``--verify-weights``; ``read_tfile`` verifies a whole-file
+  digest.  crc32 (zlib, stdlib) is the algorithm: this is corruption
+  *detection* on trusted storage, not an adversarial MAC, and crc32
+  streams at memory bandwidth with no dependencies.
+* **Counters** — process-global verification counters exported verbatim
+  at the API server's ``/metrics`` (docs/ROBUSTNESS.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import zlib
+
+MANIFEST_FORMAT = "dllama-manifest"
+MANIFEST_VERSION = 1
+MANIFEST_SUFFIX = ".sum"
+
+
+class ArtifactError(ValueError):
+    """A model/tokenizer/snapshot artifact failed validation.
+
+    Carries structured context (``path``, ``field``, ``offset``,
+    ``expected``, ``got``) and renders it all into the message so the
+    failure is diagnosable from a log line.  A ``ValueError`` subclass:
+    the pre-integrity loaders raised bare ValueErrors and callers (tests,
+    the CLI) match on that.
+    """
+
+    def __init__(self, path, field: str, message: str, *,
+                 offset: int | None = None, expected=None, got=None):
+        self.path = str(path) if path is not None else None
+        self.field = field
+        self.offset = offset
+        self.expected = expected
+        self.got = got
+        loc = f" at byte {offset}" if offset is not None else ""
+        detail = ""
+        if expected is not None or got is not None:
+            detail = f" (expected {expected!r}, got {got!r})"
+        where = f"{self.path}: " if self.path else ""
+        super().__init__(f"{where}{field}{loc}: {message}{detail}")
+
+
+# -- verification counters (exported at /metrics) -------------------------
+
+_counter_lock = threading.Lock()
+#: seeded with every counter /metrics exports so the keys are present
+#: from boot (a counter that appears only after its first failure reads
+#: as "metric missing" to a dashboard, not "zero failures")
+_counters = {"checksum_verified": 0, "checksum_failures": 0,
+             "numeric_faults": 0, "snapshot_restores": 0}
+
+
+def bump_counter(name: str, n: int = 1) -> None:
+    with _counter_lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def counters() -> dict:
+    """Snapshot of the process-global verification counters."""
+    with _counter_lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    """Test isolation helper."""
+    with _counter_lock:
+        for k in _counters:
+            _counters[k] = 0
+
+
+# -- digests ---------------------------------------------------------------
+
+def digest(data) -> int:
+    """crc32 of a bytes-like object (numpy arrays accepted)."""
+    return zlib.crc32(memoryview(data).cast("B")) & 0xFFFFFFFF
+
+
+def _file_crc32(path, offset: int = 0, nbytes: int | None = None,
+                chunk: int = 1 << 24) -> int:
+    crc = 0
+    remaining = nbytes
+    with open(path, "rb") as f:
+        f.seek(offset)
+        while True:
+            n = chunk if remaining is None else min(chunk, remaining)
+            if n == 0:
+                break
+            buf = f.read(n)
+            if not buf:
+                break
+            crc = zlib.crc32(buf, crc)
+            if remaining is not None:
+                remaining -= len(buf)
+    return crc & 0xFFFFFFFF
+
+
+# -- manifest build / write / load ----------------------------------------
+
+def manifest_path_for(artifact_path) -> str:
+    return os.fspath(artifact_path) + MANIFEST_SUFFIX
+
+
+def build_manifest(path, weights_ftype: int | None = None) -> dict:
+    """Build a manifest dict for an artifact.
+
+    ``.m`` model files get a per-tensor manifest (header digest + one
+    crc32 per tensor byte-range, in the canonical tensor-plan order);
+    any other file (e.g. a ``.t`` tokenizer) gets a whole-file digest
+    stored as its ``header`` entry — the lazy-verification granularity
+    only matters for the multi-GB weights.  ``weights_ftype`` covers
+    legacy ``.m`` files whose header omits the weight float type (the
+    tensor byte-ranges depend on it).
+    """
+    from . import mfile  # lazy: mfile imports this module for ArtifactError
+
+    path = os.fspath(path)
+    size = os.path.getsize(path)
+    man = {
+        "format": MANIFEST_FORMAT,
+        "version": MANIFEST_VERSION,
+        "algorithm": "crc32",
+        "file": os.path.basename(path),
+        "file_size": size,
+        "tensors": {},
+    }
+    with open(path, "rb") as f:
+        magic_bytes = f.read(4)
+    magic = int.from_bytes(magic_bytes, "little", signed=True) \
+        if len(magic_bytes) == 4 else None
+    if magic == mfile.MAGIC_V2 or magic in mfile.LEGACY_MAGICS:
+        spec = mfile.read_spec(path, weights_ftype=weights_ftype)
+        man["header"] = {"offset": 0, "nbytes": spec.header_size,
+                         "crc32": _file_crc32(path, 0, spec.header_size)}
+        for t in mfile.tensor_plan(spec):
+            man["tensors"][t.name] = {
+                "offset": t.offset, "nbytes": t.nbytes,
+                "crc32": _file_crc32(path, t.offset, t.nbytes)}
+    else:
+        man["header"] = {"offset": 0, "nbytes": size,
+                         "crc32": _file_crc32(path, 0, size)}
+    return man
+
+
+def write_manifest(path, manifest_path=None,
+                   weights_ftype: int | None = None) -> str:
+    """Build and write the sidecar manifest for ``path``; returns its path."""
+    mp = manifest_path or manifest_path_for(path)
+    man = build_manifest(path, weights_ftype=weights_ftype)
+    tmp = mp + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(man, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, mp)
+    return mp
+
+
+def load_manifest(manifest_path) -> dict:
+    """Load + validate a manifest file; raises ArtifactError when it is
+    itself corrupt (a manifest that cannot be trusted must not silently
+    disable verification)."""
+    try:
+        with open(manifest_path, "r", encoding="utf-8") as f:
+            man = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise ArtifactError(manifest_path, "manifest",
+                            f"unreadable manifest: {e}") from e
+    if not isinstance(man, dict) or man.get("format") != MANIFEST_FORMAT:
+        raise ArtifactError(manifest_path, "manifest.format",
+                            "not a dllama checksum manifest",
+                            expected=MANIFEST_FORMAT,
+                            got=man.get("format") if isinstance(man, dict) else type(man).__name__)
+    if man.get("version") != MANIFEST_VERSION:
+        raise ArtifactError(manifest_path, "manifest.version",
+                            "unsupported manifest version",
+                            expected=MANIFEST_VERSION, got=man.get("version"))
+    if man.get("algorithm") != "crc32":
+        raise ArtifactError(manifest_path, "manifest.algorithm",
+                            "unsupported digest algorithm",
+                            expected="crc32", got=man.get("algorithm"))
+    for key in ("file_size", "header", "tensors"):
+        if key not in man:
+            raise ArtifactError(manifest_path, f"manifest.{key}",
+                                "missing required manifest key")
+    return man
+
+
+def load_manifest_for(artifact_path) -> dict | None:
+    """The artifact's sidecar manifest, or None when none exists."""
+    mp = manifest_path_for(artifact_path)
+    if not os.path.exists(mp):
+        return None
+    return load_manifest(mp)
+
+
+def verify_bytes(entry: dict, data, path, field: str) -> None:
+    """Verify a byte region against its manifest entry (crc32 + length).
+
+    Bumps the process-global counters; raises :class:`ArtifactError`
+    naming the region's file offset on any mismatch.
+    """
+    nbytes = memoryview(data).cast("B").nbytes
+    if nbytes != entry["nbytes"]:
+        bump_counter("checksum_failures")
+        raise ArtifactError(path, field, "region size mismatch vs manifest",
+                            offset=entry["offset"],
+                            expected=entry["nbytes"], got=nbytes)
+    got = digest(data)
+    if got != entry["crc32"]:
+        bump_counter("checksum_failures")
+        raise ArtifactError(
+            path, field, "checksum mismatch — artifact bytes are corrupt",
+            offset=entry["offset"],
+            expected=f"crc32={entry['crc32']:#010x}", got=f"crc32={got:#010x}")
+    bump_counter("checksum_verified")
+
+
+def verify_file(path, manifest: dict | None = None) -> int:
+    """Fully verify an artifact against its manifest (every region).
+
+    Returns the number of regions verified; raises ArtifactError on the
+    first mismatch.  This is the eager path ``tools/checksum_model.py
+    verify`` uses; ``MFile`` verifies the same regions lazily instead.
+    """
+    man = manifest if manifest is not None else load_manifest(manifest_path_for(path))
+    size = os.path.getsize(path)
+    if size != man["file_size"]:
+        bump_counter("checksum_failures")
+        raise ArtifactError(path, "file size", "size mismatch vs manifest",
+                            expected=man["file_size"], got=size)
+    regions = [("header", man["header"])]
+    regions += [(f"tensor {name!r}", ent)
+                for name, ent in man["tensors"].items()]
+    for field, ent in regions:
+        got = _file_crc32(path, ent["offset"], ent["nbytes"])
+        if got != ent["crc32"]:
+            bump_counter("checksum_failures")
+            raise ArtifactError(
+                path, field, "checksum mismatch — artifact bytes are corrupt",
+                offset=ent["offset"],
+                expected=f"crc32={ent['crc32']:#010x}", got=f"crc32={got:#010x}")
+        bump_counter("checksum_verified")
+    return len(regions)

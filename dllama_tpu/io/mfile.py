@@ -31,6 +31,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from .. import quants
+from .integrity import ArtifactError, load_manifest_for, verify_bytes
 
 MAGIC_V2 = 0xA00ABCD
 LEGACY_MAGICS = (0xABCD00, 0xABCD01)
@@ -151,8 +152,93 @@ def tensor_plan(spec: ModelSpec) -> list[TensorInfo]:
     return plan
 
 
+def _read_exact(f, n: int, path, field: str) -> tuple[bytes, int]:
+    """Read exactly ``n`` bytes or raise ArtifactError naming the offset —
+    the loader-level replacement for letting ``struct.error`` escape on a
+    truncated file."""
+    off = f.tell()
+    data = f.read(n)
+    if len(data) != n:
+        raise ArtifactError(path, field,
+                            "file truncated mid-field",
+                            offset=off, expected=f"{n} bytes",
+                            got=f"{len(data)} bytes")
+    return data, off
+
+
+#: sanity ceilings for header-declared sizes.  A bit flip in a size field
+#: must fail the parse, not drive a multi-minute tensor-plan walk or a
+#: giant allocation; every bound sits far above any real model.
+_SPEC_BOUNDS = {
+    "dim": (1, 1 << 20),
+    "hidden_dim": (1, 1 << 24),
+    "n_layers": (1, 4096),
+    "n_heads": (1, 4096),
+    "n_kv_heads": (1, 4096),
+    "n_experts": (0, 512),
+    "n_active_experts": (0, 512),
+    "vocab_size": (1, 1 << 24),
+    "seq_len": (1, 1 << 24),
+}
+
+
+def validate_spec(spec: ModelSpec, path) -> ModelSpec:
+    """Structural validation of a parsed header: range-check every field
+    and the cross-field divisibility invariants the runtime assumes.
+    Raises :class:`ArtifactError` naming the offending field."""
+    for field, (lo, hi) in _SPEC_BOUNDS.items():
+        v = getattr(spec, field)
+        if not (lo <= v <= hi):
+            raise ArtifactError(path, f"header field {field}",
+                                "value out of range — corrupt header",
+                                expected=f"{lo}..{hi}", got=v)
+    if spec.arch not in ARCH_NAMES:
+        raise ArtifactError(path, "header field arch",
+                            "unknown architecture id",
+                            expected=sorted(hex(a) for a in ARCH_NAMES),
+                            got=hex(spec.arch))
+    if spec.hidden_act not in (ACT_GELU, ACT_SILU):
+        raise ArtifactError(path, "header field hidden_act",
+                            "unknown activation id", expected="0|1",
+                            got=spec.hidden_act)
+    if spec.weights_ftype not in quants.FLOAT_TYPE_NAMES:
+        raise ArtifactError(path, "header field weights_ftype",
+                            "unknown weights float type",
+                            expected=sorted(quants.FLOAT_TYPE_NAMES),
+                            got=spec.weights_ftype)
+    if not spec.rope_theta > 0:
+        raise ArtifactError(path, "header field rope_theta",
+                            "must be positive", got=spec.rope_theta)
+    if spec.n_kv_heads > spec.n_heads:
+        raise ArtifactError(path, "header field n_kv_heads",
+                            "more KV heads than attention heads",
+                            expected=f"<= {spec.n_heads}", got=spec.n_kv_heads)
+    if spec.dim % spec.n_heads:
+        raise ArtifactError(path, "header field n_heads",
+                            "dim not divisible by n_heads",
+                            expected=f"divisor of dim={spec.dim}",
+                            got=spec.n_heads)
+    if spec.n_heads % spec.n_kv_heads:
+        raise ArtifactError(path, "header field n_kv_heads",
+                            "n_heads not divisible by n_kv_heads (GQA)",
+                            expected=f"divisor of n_heads={spec.n_heads}",
+                            got=spec.n_kv_heads)
+    if spec.n_active_experts > spec.n_experts:
+        raise ArtifactError(path, "header field n_active_experts",
+                            "more active experts than experts",
+                            expected=f"<= {spec.n_experts}",
+                            got=spec.n_active_experts)
+    return spec
+
+
 def read_spec(path: str | os.PathLike, weights_ftype: int | None = None) -> ModelSpec:
-    """Parse a `.m` header (transformer.cpp:12-125).
+    """Parse + validate a `.m` header (transformer.cpp:12-125).
+
+    Fully bounds-checked (beyond reference — ``loadSpecFromFile`` trusts
+    its input): every read is length-checked, the declared header size is
+    checked against the file, keys/values are range-checked, and any
+    violation raises :class:`ArtifactError` with the file offset and field
+    name — never ``struct.error``.
 
     ``weights_ftype`` mirrors the reference's mandatory
     ``--weights-float-type`` flag: legacy-magic files don't carry the weight
@@ -161,21 +247,36 @@ def read_spec(path: str | os.PathLike, weights_ftype: int | None = None) -> Mode
     """
     spec = ModelSpec()
     found_wft = False
+    file_size = os.path.getsize(path)
     with open(path, "rb") as f:
-        (magic,) = struct.unpack("<i", f.read(4))
+        raw, _ = _read_exact(f, 4, path, "magic")
+        (magic,) = struct.unpack("<i", raw)
         if magic in LEGACY_MAGICS:
-            vals = struct.unpack("<9i", f.read(36))
+            raw, off = _read_exact(f, 36, path, "legacy header")
+            vals = struct.unpack("<9i", raw)
             spec.arch = magic
             (spec.dim, spec.hidden_dim, spec.n_layers, spec.n_heads,
              spec.n_kv_heads, spec.n_experts, spec.n_active_experts,
              spec.vocab_size, spec.seq_len) = vals
             spec.header_size = 4 + 36
         elif magic == MAGIC_V2:
-            (header_size,) = struct.unpack("<i", f.read(4))
+            raw, off = _read_exact(f, 4, path, "headerSize")
+            (header_size,) = struct.unpack("<i", raw)
+            if header_size < 8 or (header_size - 8) % 8:
+                raise ArtifactError(
+                    path, "headerSize",
+                    "must be 8 + a whole number of (key, value) i32 pairs",
+                    offset=off, expected="8 + 8k", got=header_size)
+            if header_size > file_size:
+                raise ArtifactError(path, "headerSize",
+                                    "header extends past end of file",
+                                    offset=off, expected=f"<= {file_size}",
+                                    got=header_size)
             spec.header_size = header_size
-            body = f.read(header_size - 8)
+            body, body_off = _read_exact(f, header_size - 8, path, "header body")
             kv = struct.unpack(f"<{len(body) // 4}i", body)
-            for k, v in zip(kv[::2], kv[1::2]):
+            for i, (k, v) in enumerate(zip(kv[::2], kv[1::2])):
+                pair_off = body_off + 8 * i
                 if k == KEY_VERSION:
                     spec.version = v
                 elif k == KEY_ARCH_TYPE:
@@ -206,39 +307,94 @@ def read_spec(path: str | os.PathLike, weights_ftype: int | None = None) -> Mode
                     spec.weights_ftype = v
                     found_wft = True
                 else:
-                    raise ValueError(f"unsupported .m header key {k}")
+                    raise ArtifactError(path, "header key",
+                                        "unsupported .m header key",
+                                        offset=pair_off,
+                                        expected=f"0..{KEY_WEIGHTS_FLOAT_TYPE}",
+                                        got=k)
         else:
-            raise ValueError(f"unsupported model file magic {magic:#x}")
+            raise ArtifactError(path, "magic",
+                                "unsupported model file magic",
+                                offset=0,
+                                expected=[hex(MAGIC_V2)] + [hex(m) for m in LEGACY_MAGICS],
+                                got=hex(magic & 0xFFFFFFFF))
     # Precedence mirrors the reference: the header's WEIGHTS_FLOAT_TYPE key
     # overwrites the caller/CLI value (transformer.cpp:66-74 loop overwrites
     # the argument); the explicit argument only covers files lacking the key.
     if not found_wft:
         if weights_ftype is None:
-            raise ValueError(
+            raise ArtifactError(
+                path, "header field weights_ftype",
                 "model file does not specify weights float type; pass weights_ftype "
                 "(reference: 'Not specified weights float type', transformer.cpp:80-81)")
         spec.weights_ftype = weights_ftype
-    return spec
+    return validate_spec(spec, path)
 
 
 class MFile:
-    """mmap-backed lazy `.m` reader."""
+    """mmap-backed lazy `.m` reader with integrity checking.
 
-    def __init__(self, path: str | os.PathLike, weights_ftype: int | None = None):
+    When a sidecar checksum manifest (``<path>.sum``, io/integrity.py,
+    written by ``tools/checksum_model.py``) exists, the header digest is
+    verified at open **always**, and each tensor's digest is verified on
+    first read when ``verify=True`` (the CLI's ``--verify-weights``) —
+    lazy, so sharded loading still streams without a full pre-pass, yet
+    every byte the runtime consumes was checksummed.  ``verify=True``
+    with no manifest is an error: silently skipping requested
+    verification would defeat its purpose.
+    """
+
+    def __init__(self, path: str | os.PathLike, weights_ftype: int | None = None,
+                 verify: bool = False):
         self.path = os.fspath(path)
         self.spec = read_spec(path, weights_ftype)
-        self.plan = tensor_plan(self.spec)
-        self.by_name = {t.name: t for t in self.plan}
+        self.verify_weights = verify
+        self.manifest = load_manifest_for(self.path)
+        self._verified: set[str] = set()
+        if verify and self.manifest is None:
+            raise ArtifactError(
+                self.path, "manifest",
+                "weight verification requested but no checksum manifest "
+                f"found at {self.path}.sum (generate one with "
+                "tools/checksum_model.py write)")
         self._f = open(self.path, "rb")
         self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        if self.manifest is not None:
+            # header digest is always-on, and it runs BEFORE the tensor
+            # plan is derived: every plan offset/size below comes from
+            # header fields, so a flipped header must be caught here, not
+            # surface as a downstream shape error
+            if self.manifest["file_size"] != len(self._mm):
+                raise ArtifactError(self.path, "file size",
+                                    "size mismatch vs manifest",
+                                    expected=self.manifest["file_size"],
+                                    got=len(self._mm))
+            verify_bytes(self.manifest["header"],
+                         self._mm[:self.spec.header_size], self.path, "header")
+        try:
+            self.plan = tensor_plan(self.spec)
+        except ValueError as e:
+            # spec fields were individually in range but jointly impossible
+            # (e.g. a flipped vocab_size that breaks quant block alignment)
+            raise ArtifactError(
+                self.path, "header",
+                f"header describes an impossible tensor plan: {e}") from e
+        self.by_name = {t.name: t for t in self.plan}
         end = self.plan[-1].offset + self.plan[-1].nbytes
         if len(self._mm) != end:
-            raise ValueError(
+            raise ArtifactError(
+                self.path, "file size",
                 f"model file size mismatch: file={len(self._mm)} expected={end} "
-                f"(reference errors the same way, transformer.cpp:480-484)")
+                f"(reference errors the same way, transformer.cpp:480-484)",
+                expected=end, got=len(self._mm))
 
     def close(self):
-        self._mm.close()
+        try:
+            self._mm.close()
+        except BufferError:
+            # zero-copy views handed out by raw() still reference the map;
+            # it closes when the last view is collected
+            pass
         self._f.close()
 
     def __enter__(self):
@@ -247,19 +403,58 @@ class MFile:
     def __exit__(self, *exc):
         self.close()
 
+    def info(self, name: str) -> TensorInfo:
+        """Plan entry for ``name``; unknown names raise ArtifactError
+        listing what the file actually contains (never a bare KeyError)."""
+        t = self.by_name.get(name)
+        if t is None:
+            sample = ", ".join(sorted(self.by_name)[:6])
+            raise ArtifactError(
+                self.path, f"tensor {name!r}",
+                f"unknown tensor name; this {self.spec.arch_name} file has "
+                f"{len(self.by_name)} tensors ({sample}, ...)")
+        return t
+
     def raw(self, name: str) -> np.ndarray:
-        t = self.by_name[name]
-        return np.frombuffer(self._mm, dtype=np.uint8, count=t.nbytes, offset=t.offset)
+        """One tensor's packed file bytes (checksum-verified on first read
+        under ``verify=True``).  The ``io.read_tensor`` fault point's
+        ``corrupt`` action flips a byte of the returned buffer — the
+        deterministic stand-in for storage corruption that lets drills
+        prove the manifest catches it (runtime/faults.py)."""
+        from ..runtime.faults import FAULTS
+        t = self.info(name)
+        buf = np.frombuffer(self._mm, dtype=np.uint8, count=t.nbytes,
+                            offset=t.offset)
+        if "corrupt" in FAULTS.fire("io.read_tensor"):
+            buf = buf.copy()
+            buf[0] ^= 0xFF
+        if self.verify_weights and name not in self._verified:
+            ent = self.manifest["tensors"].get(name)
+            if ent is None:
+                raise ArtifactError(self.path, f"tensor {name!r}",
+                                    "tensor missing from checksum manifest "
+                                    "(stale manifest? regenerate it)")
+            if (ent["offset"], ent["nbytes"]) != (t.offset, t.nbytes):
+                raise ArtifactError(
+                    self.path, f"tensor {name!r}",
+                    "manifest byte range disagrees with the file's tensor "
+                    "plan (stale manifest? regenerate it)",
+                    offset=t.offset,
+                    expected=(ent["offset"], ent["nbytes"]),
+                    got=(t.offset, t.nbytes))
+            verify_bytes(ent, buf, self.path, f"tensor {name!r}")
+            self._verified.add(name)
+        return buf
 
     def tensor(self, name: str) -> np.ndarray:
         """Dequantize one tensor to f32 in its logical row-major shape."""
-        t = self.by_name[name]
+        t = self.info(name)
         n = int(np.prod(t.shape))
         return quants.dequantize_tensor(self.raw(name), t.ftype, n).reshape(t.shape)
 
     def q40_planes(self, name: str) -> tuple[np.ndarray, np.ndarray]:
         """Unpacked int8 values + per-block scales for a Q40 matmul tensor."""
-        t = self.by_name[name]
+        t = self.info(name)
         if t.ftype != quants.Q40:
             raise ValueError(f"{name} is not Q40")
         d = int(np.prod(t.shape[:-1]))

@@ -19,8 +19,16 @@ import os
 import struct
 from dataclasses import dataclass, field
 
+from .integrity import ArtifactError, load_manifest_for, verify_bytes
+
 MAGIC_V1 = 0x567124
 MAGIC_LEGACY = 0x567123
+
+#: sanity ceilings: a bit-flipped length field must fail the parse, not
+#: drive a giant read.  Far above any real tokenizer.
+_MAX_VOCAB = 1 << 24
+_MAX_TOKEN_BYTES = 1 << 16
+_MAX_STR_BYTES = 1 << 20
 
 # TokenizerHeaderKey (tokenizer.hpp:24-34)
 TOK_VERSION = 0
@@ -50,21 +58,66 @@ class TokenizerData:
         return len(self.vocab)
 
 
+def _read_exact(f, n: int, path, field: str) -> tuple[bytes, int]:
+    off = f.tell()
+    data = f.read(n)
+    if len(data) != n:
+        raise ArtifactError(path, field, "file truncated mid-field",
+                            offset=off, expected=f"{n} bytes",
+                            got=f"{len(data)} bytes")
+    return data, off
+
+
 def read_tfile(path: str | os.PathLike) -> TokenizerData:
+    """Parse + validate a `.t` tokenizer file.
+
+    Fully bounds-checked (beyond reference — ``Tokenizer::Tokenizer``
+    trusts its input): every read is length-checked, every declared
+    length/count is range-checked, trailing garbage is rejected, and any
+    violation raises :class:`ArtifactError` with the file offset and
+    field name — never ``struct.error``.  When a sidecar checksum
+    manifest (``<path>.sum``) exists, the whole file is verified against
+    it first, so even a flip inside a token's raw bytes (which no
+    structural check can see) is caught.
+    """
+    path = os.fspath(path)
+    man = load_manifest_for(path)
+    file_size = os.path.getsize(path)
+    if man is not None:
+        if man["file_size"] != file_size:
+            raise ArtifactError(path, "file size", "size mismatch vs manifest",
+                                expected=man["file_size"], got=file_size)
+        with open(path, "rb") as f:
+            verify_bytes(man["header"], f.read(), path, "file")
     t = TokenizerData()
     with open(path, "rb") as f:
-        (magic,) = struct.unpack("<i", f.read(4))
+        raw, _ = _read_exact(f, 4, path, "magic")
+        (magic,) = struct.unpack("<i", raw)
         if magic == MAGIC_LEGACY:
-            vocab_size, t.max_token_length = struct.unpack("<II", f.read(8))
-            t.bos_id, t.eos_id, _pad = struct.unpack("<iii", f.read(12))
+            raw, off = _read_exact(f, 8, path, "legacy header")
+            vocab_size, t.max_token_length = struct.unpack("<II", raw)
+            raw, _ = _read_exact(f, 12, path, "legacy header ids")
+            t.bos_id, t.eos_id, _pad = struct.unpack("<iii", raw)
         elif magic == MAGIC_V1:
-            (header_size,) = struct.unpack("<i", f.read(4))
-            body = f.read(header_size - 8)
+            raw, off = _read_exact(f, 4, path, "headerSize")
+            (header_size,) = struct.unpack("<i", raw)
+            if header_size < 8 or (header_size - 8) % 8:
+                raise ArtifactError(
+                    path, "headerSize",
+                    "must be 8 + a whole number of (key, value) i32 pairs",
+                    offset=off, expected="8 + 8k", got=header_size)
+            if header_size > file_size:
+                raise ArtifactError(path, "headerSize",
+                                    "header extends past end of file",
+                                    offset=off, expected=f"<= {file_size}",
+                                    got=header_size)
+            body, body_off = _read_exact(f, header_size - 8, path, "header body")
             kv = struct.unpack(f"<{len(body) // 4}i", body)
             version = -1
             vocab_size = 0
             template_len = stop_len = 0
-            for k, v in zip(kv[::2], kv[1::2]):
+            for i, (k, v) in enumerate(zip(kv[::2], kv[1::2])):
+                pair_off = body_off + 8 * i
                 if k == TOK_VERSION:
                     version = v
                 elif k == TOK_VOCAB_SIZE:
@@ -84,20 +137,59 @@ def read_tfile(path: str | os.PathLike) -> TokenizerData:
                 elif k == PAD_ID:
                     pass  # ignored by the reference too (tokenizer.cpp:87)
                 else:
-                    raise ValueError(f"invalid tokenizer header key {k}")
+                    raise ArtifactError(path, "header key",
+                                        "invalid tokenizer header key",
+                                        offset=pair_off,
+                                        expected=f"0..{CHAT_STOP}", got=k)
             if version != 1:
-                raise ValueError("old tokenizer version, please regenerate")
+                raise ArtifactError(path, "header field version",
+                                    "old tokenizer version, please regenerate",
+                                    expected=1, got=version)
+            for field_name, v in (("chat_template length", template_len),
+                                  ("chat_stop length", stop_len)):
+                if not (0 <= v <= _MAX_STR_BYTES):
+                    raise ArtifactError(path, f"header field {field_name}",
+                                        "value out of range — corrupt header",
+                                        expected=f"0..{_MAX_STR_BYTES}", got=v)
             if template_len > 0:
-                t.chat_template = f.read(template_len).decode("utf-8", errors="replace")
+                raw, _ = _read_exact(f, template_len, path, "chat_template")
+                t.chat_template = raw.decode("utf-8", errors="replace")
             if stop_len > 0:
-                t.chat_stop = f.read(stop_len).decode("utf-8", errors="replace")
+                raw, _ = _read_exact(f, stop_len, path, "chat_stop")
+                t.chat_stop = raw.decode("utf-8", errors="replace")
         else:
-            raise ValueError(f"invalid tokenizer file magic {magic:#x}")
+            raise ArtifactError(path, "magic",
+                                "invalid tokenizer file magic", offset=0,
+                                expected=[hex(MAGIC_V1), hex(MAGIC_LEGACY)],
+                                got=hex(magic & 0xFFFFFFFF))
 
-        for _ in range(vocab_size):
-            score, length = struct.unpack("<fi", f.read(8))
+        if not (0 <= vocab_size <= _MAX_VOCAB):
+            raise ArtifactError(path, "header field vocab_size",
+                                "value out of range — corrupt header",
+                                expected=f"0..{_MAX_VOCAB}", got=vocab_size)
+        if not (0 <= t.max_token_length <= _MAX_TOKEN_BYTES):
+            raise ArtifactError(path, "header field max_token_length",
+                                "value out of range — corrupt header",
+                                expected=f"0..{_MAX_TOKEN_BYTES}",
+                                got=t.max_token_length)
+        for i in range(vocab_size):
+            raw, off = _read_exact(f, 8, path, f"vocab[{i}] score+length")
+            score, length = struct.unpack("<fi", raw)
+            if not (0 <= length <= _MAX_TOKEN_BYTES):
+                raise ArtifactError(path, f"vocab[{i}] length",
+                                    "token length out of range — corrupt vocab",
+                                    offset=off + 4,
+                                    expected=f"0..{_MAX_TOKEN_BYTES}", got=length)
+            piece, _ = _read_exact(f, length, path, f"vocab[{i}] bytes")
             t.scores.append(score)
-            t.vocab.append(f.read(length))
+            t.vocab.append(piece)
+        trailing = f.read(1)
+        if trailing:
+            raise ArtifactError(path, "end of file",
+                                "trailing bytes after vocab — corrupt or "
+                                "mis-sized file", offset=f.tell() - 1,
+                                expected="EOF",
+                                got=f"{file_size - f.tell() + 1} extra bytes")
     return t
 
 

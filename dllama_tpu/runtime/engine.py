@@ -77,6 +77,29 @@ class ContextOverflow(ValueError):
     masking unrelated ValueErrors as client errors (ADVICE r01)."""
 
 
+class NumericFault(RuntimeError):
+    """NaN/Inf detected in the logits under ``numeric_checks``.
+
+    The reference has no numeric guard at all: a corrupt weight or a
+    numerically-diverged KV cache surfaces as garbage *text* (or a
+    sampler crash) minutes later, with no pointer back to the step that
+    went bad.  With ``--numeric-checks`` the engine checks every
+    host-fetched logits array and raises this instead, naming the step,
+    the sequence position, and a hint — detection happens at the logits
+    (the one tensor the host already sees each step, so the check costs
+    no extra device→host traffic), which cannot name the layer that
+    produced the NaN; the hint says what to bisect next.  The server
+    maps it to a 500 and resets the engine (a NaN anywhere implies the
+    KV cache may be poisoned)."""
+
+    def __init__(self, step: str, pos: int, hint: str = ""):
+        self.step = step
+        self.pos = pos
+        self.hint = hint
+        msg = f"non-finite logits at {step}, pos={pos}"
+        super().__init__(msg + (f" ({hint})" if hint else ""))
+
+
 class StepTimeout(RuntimeError):
     """A device step exceeded the engine's watchdog deadline.
 
@@ -143,13 +166,22 @@ class Engine:
     def __init__(self, cfg: ModelConfig, params: Params, mesh=None,
                  batch: int = 1, seq_len: int | None = None, kv_dtype=None,
                  timing_mode: str | None = None,
-                 step_timeout: float | None = None):
+                 step_timeout: float | None = None,
+                 numeric_checks: bool | None = None):
         self.batch = batch
         # decode watchdog (see StepTimeout); 0/None disables.  Env default
         # so a live server can arm it without a code path change.
         if step_timeout is None:
             step_timeout = float(os.environ.get("DLLAMA_STEP_TIMEOUT", "0"))
         self.step_timeout = step_timeout if step_timeout > 0 else None
+        # opt-in NaN/Inf guard over every host-fetched logits array (see
+        # NumericFault); env default mirrors the watchdog.  Off by
+        # default: np.isfinite over (B, V) costs ~µs but the *policy*
+        # (fail the request) should be a choice.
+        if numeric_checks is None:
+            numeric_checks = os.environ.get(
+                "DLLAMA_NUMERIC_CHECKS", "") not in ("", "0", "false")
+        self.numeric_checks = bool(numeric_checks)
         # I/T attribution source (VERDICT r04 Weak #1).  "device-ready":
         # block_until_ready marks end-of-execution and the remaining fetch
         # is T — correct on local backends.  "host-fetch": on a tunneled
@@ -264,6 +296,109 @@ class Engine:
         self.pos = 0
         self._offsets = None
 
+    # -- state snapshot/restore (runtime/snapshot.py format) -----------
+    def config_fingerprint(self) -> str:
+        """Short digest of everything that must match for a snapshot's
+        state to be meaningful in this engine: model hyperparameters,
+        batch, context length, and the cache's dtype/shape layout.  Mesh
+        shape is deliberately excluded — KV *values* are placement-
+        independent, so a snapshot taken on one mesh restores onto
+        another (device_put reshards)."""
+        from . import snapshot as snapfmt
+        c = self.cfg
+        fields = {
+            "arch": c.arch, "dim": c.dim, "hidden_dim": c.hidden_dim,
+            "n_layers": c.n_layers, "n_heads": c.n_heads,
+            "n_kv_heads": c.n_kv_heads, "n_experts": c.n_experts,
+            "n_active_experts": c.n_active_experts,
+            "vocab_size": c.vocab_size, "hidden_act": c.hidden_act,
+            "rope_theta": c.rope_theta,
+            "batch": self.batch, "seq_len": self.seq_len,
+            "cache": [[n, str(a.dtype), list(a.shape)]
+                      for n, a in self._cache_arrays().items()],
+        }
+        return snapfmt.fingerprint(fields)
+
+    def _cache_arrays(self) -> dict:
+        out = {"cache.k": self.cache.k, "cache.v": self.cache.v}
+        if self.cache.quantized:
+            out["cache.k_scale"] = self.cache.k_scale
+            out["cache.v_scale"] = self.cache.v_scale
+        return out
+
+    def snapshot(self, path: str | os.PathLike,
+                 extra: dict | None = None) -> str:
+        """Serialize the engine's conversation state (KV cache, position,
+        sampler RNG stream, ragged offsets) to a versioned, checksummed
+        file (runtime/snapshot.py).  Atomic; returns the path.  ``extra``
+        is caller JSON carried in the snapshot meta and handed back by
+        :meth:`restore` (the API server stores its conversation cache
+        there so a warm restart resumes chats, not just KV bytes)."""
+        from . import snapshot as snapfmt
+        arrays = {n: np.asarray(a) for n, a in self._cache_arrays().items()}
+        arrays["rng_key"] = np.asarray(self._key)
+        meta_extra = dict(extra or {})
+        if self._offsets is not None:
+            arrays["offsets"] = np.asarray(self._offsets)
+            meta_extra["has_offsets"] = True
+        return snapfmt.save(path, fingerprint=self.config_fingerprint(),
+                            pos=self.pos, chunk_counter=self._chunk_counter,
+                            arrays=arrays, extra=meta_extra)
+
+    def restore(self, path: str | os.PathLike) -> dict:
+        """Restore state saved by :meth:`snapshot`.
+
+        Raises :class:`~dllama_tpu.io.integrity.ArtifactError` on
+        corruption and its :class:`~dllama_tpu.runtime.snapshot.
+        SnapshotMismatch` subclass when the snapshot came from a
+        differently-shaped engine — the caller (server boot) catches
+        ArtifactError and cold-starts.  On success the continued decode
+        stream is token-identical to never having restarted
+        (tests/test_snapshot.py); returns the snapshot's ``extra`` dict."""
+        from ..io.integrity import bump_counter
+        from ..models.transformer import KVCache
+        from . import snapshot as snapfmt
+        meta, arrays = snapfmt.load(path)
+        want_fp = self.config_fingerprint()
+        if meta["fingerprint"] != want_fp:
+            raise snapfmt.SnapshotMismatch(
+                path, "fingerprint",
+                "snapshot is from a differently-configured engine",
+                expected=want_fp, got=meta["fingerprint"])
+        cache_np = {}
+        for name, cur in self._cache_arrays().items():
+            arr = arrays.get(name)
+            if arr is None:
+                raise snapfmt.SnapshotMismatch(
+                    path, f"array {name!r}", "missing cache array")
+            if tuple(arr.shape) != tuple(cur.shape) or \
+                    str(arr.dtype) != str(np.asarray(cur).dtype):
+                raise snapfmt.SnapshotMismatch(
+                    path, f"array {name!r}",
+                    "cache array layout mismatch",
+                    expected=f"{np.asarray(cur).dtype}{tuple(cur.shape)}",
+                    got=f"{arr.dtype}{tuple(arr.shape)}")
+            cache_np[name] = arr
+        pos = int(meta["pos"])
+        if not (0 <= pos <= self.seq_len):
+            raise snapfmt.SnapshotMismatch(
+                path, "pos", "restored position outside the context window",
+                expected=f"0..{self.seq_len}", got=pos)
+        if self.cache.quantized:
+            cache = KVCache(cache_np["cache.k"], cache_np["cache.v"],
+                            cache_np["cache.k_scale"], cache_np["cache.v_scale"])
+        else:
+            cache = KVCache(cache_np["cache.k"], cache_np["cache.v"])
+        self.cache = jax.device_put(cache, self._cache_sh)
+        self.pos = pos
+        self._chunk_counter = int(meta["chunk_counter"])
+        self._key = jnp.asarray(arrays["rng_key"]) if "rng_key" in arrays \
+            else jax.random.PRNGKey(0)
+        self._offsets = jnp.asarray(arrays["offsets"]) \
+            if meta.get("extra", {}).get("has_offsets") else None
+        bump_counter("snapshot_restores")
+        return dict(meta.get("extra", {}))
+
     def _sync(self, arrays, what: str) -> list[str]:
         """Block until ``arrays`` are device-ready — THE engine's blocking
         edge — under the watchdog, firing the ``engine.device_step`` fault
@@ -305,6 +440,33 @@ class Engine:
             raise box["error"]
         return box["actions"]
 
+    def _numeric_guard(self, host_logits: np.ndarray, step: str) -> np.ndarray:
+        """Check a host-fetched logits array for NaN/Inf (``numeric_checks``
+        mode; see :class:`NumericFault`).  Fires the ``engine.numeric``
+        fault point first — its ``nan`` action poisons the checked array so
+        the fault path is testable without real corruption.  Guards cover
+        every host-logits step (prefill, single-token decode, ragged
+        prefill); the on-device chunked decode loop only ships token ids
+        to the host, so a divergence there surfaces at the next
+        host-logits step (the following turn's prefill) — the bounded
+        blind spot is documented in docs/ROBUSTNESS.md."""
+        if not self.numeric_checks:
+            return host_logits
+        from .faults import FAULTS
+        from ..io.integrity import bump_counter
+        if "nan" in FAULTS.fire("engine.numeric"):
+            host_logits = np.full_like(host_logits, np.nan)
+        if not np.isfinite(host_logits).all():
+            bump_counter("numeric_faults")
+            bad = int(np.size(host_logits) - np.count_nonzero(
+                np.isfinite(host_logits)))
+            raise NumericFault(
+                step, self.pos,
+                hint=f"{bad}/{host_logits.size} non-finite logits; detection "
+                     "is at the output logits (no layer attribution) — "
+                     "bisect with --verify-weights and a dense kv cache")
+        return host_logits
+
     def _run(self, tokens_np: np.ndarray, last_index: int,
              offsets: jax.Array | None = None) -> tuple[np.ndarray, StepStats]:
         stats = StepStats()
@@ -331,6 +493,8 @@ class Engine:
         host_logits = np.asarray(logits)  # (B, V)
         if "nan" in fired:  # injected device fault: poisoned logits
             host_logits = np.full_like(host_logits, np.nan)
+        host_logits = self._numeric_guard(
+            host_logits, "prefill" if tokens_np.shape[1] > 1 else "decode")
         t2 = time.perf_counter()
         if self.timing_mode == "host-fetch":
             # the ready marker fired at dispatch, not completion: only the

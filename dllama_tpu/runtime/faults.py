@@ -22,12 +22,20 @@ Fault points (the arming side never needs code changes to add more —
   (parallel/distributed.py); a ``raise:ConnectionError`` here is the
   coordinator not being up yet (the *normal* case under the reference's
   "workers first, then root" start-order contract).
+* ``io.read_tensor``        — on every ``MFile.raw`` tensor read
+  (io/mfile.py); ``corrupt`` flips a byte of the returned buffer — the
+  deterministic stand-in for storage corruption the checksum manifest
+  must catch.
+* ``engine.numeric``        — at the engine's logits numeric guard
+  (runtime/engine.py, ``--numeric-checks``); ``nan`` poisons the checked
+  logits so the ``NumericFault`` path is testable without real
+  corruption.
 
 Spec grammar (``DLLAMA_FAULTS`` or :meth:`FaultRegistry.install`)::
 
     spec     := entry ("," entry)*
     entry    := point "=" action [":" arg] ["@" skip] ["x" times]
-    action   := "delay" | "raise" | "disconnect" | "nan"
+    action   := "delay" | "raise" | "disconnect" | "nan" | "corrupt"
 
 * ``delay:SECONDS``  — sleep that long at the point.
 * ``raise:ExcName[:message]`` — raise the named exception (one of
@@ -35,8 +43,10 @@ Spec grammar (``DLLAMA_FAULTS`` or :meth:`FaultRegistry.install`)::
   OSError, RuntimeError, ValueError``; default :class:`FaultInjected`).
 * ``disconnect``     — raise ``BrokenPipeError`` (a dead peer).
 * ``nan``            — ask the call site to poison its value (the site
-  reads the action list ``fire()`` returns; only ``engine.device_step``
-  honors it today, by NaN-filling the fetched logits).
+  reads the action list ``fire()`` returns; ``engine.device_step`` and
+  ``engine.numeric`` honor it, by NaN-filling the fetched logits).
+* ``corrupt``        — ask the call site to flip a byte of its value
+  (``io.read_tensor`` honors it).
 * ``@skip``          — stay dormant for the first ``skip`` hits (fire
   starting on hit ``skip+1``).
 * ``xtimes``         — fire at most ``times`` times, then go dormant
@@ -77,7 +87,7 @@ _EXCEPTIONS: dict[str, type[BaseException]] = {
     "FaultInjected": FaultInjected,
 }
 
-_ACTIONS = ("delay", "raise", "disconnect", "nan")
+_ACTIONS = ("delay", "raise", "disconnect", "nan", "corrupt")
 
 
 @dataclass

@@ -1,0 +1,187 @@
+"""Engine-state snapshot file format: versioned, checksummed, validated.
+
+The reference engine's only recovery primitive is restarting from an
+empty context — a crashed or drained process loses all KV state and
+every conversation re-prefills from zero.  This module gives the engine
+a durable state file so a planned restart (deploy, reshard, preemption
+drain) is a *warm* start: the KV cache, position clock, sampler RNG, and
+ragged-batch offsets come back exactly, and continued decode is
+token-identical to an uninterrupted run (tests/test_snapshot.py pins
+this).
+
+File layout (little-endian)::
+
+    8 B   magic   b"DLSNAP01"
+    4 B   u32     meta_len
+    4 B   u32     crc32(meta || payload)
+    meta_len B    meta JSON
+    *     payload concatenated raw array bytes
+
+Meta JSON: ``{"fingerprint", "pos", "chunk_counter", "arrays": [{"name",
+"dtype", "shape", "nbytes"}, ...], "extra": {...}}``.  Arrays are stored
+in meta order, back to back, in the payload.
+
+Corruption policy mirrors io/integrity.py: every read is bounds-checked
+and the crc32 covers meta *and* payload, so a truncated or bit-flipped
+snapshot raises :class:`~dllama_tpu.io.integrity.ArtifactError` at load —
+the server's restore path catches it and falls back to a cold start with
+a logged reason, never a crash (a stale snapshot must not be able to
+take the process down).  The ``fingerprint`` is the engine's config
+fingerprint (model hyperparameters + batch + seq_len + cache layout);
+restore refuses state from a differently-shaped engine.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import struct
+import zlib
+
+import numpy as np
+
+from ..io.integrity import ArtifactError
+
+MAGIC = b"DLSNAP01"
+_HEADER = struct.Struct("<8sII")  # magic, meta_len, crc32(meta || payload)
+_MAX_META = 1 << 24
+
+
+class SnapshotMismatch(ArtifactError):
+    """A structurally valid snapshot that does not fit this engine
+    (config fingerprint or array layout mismatch).  Distinct from plain
+    corruption so callers can log "snapshot is from a different model",
+    but still an ArtifactError: both mean "cold start"."""
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16 et al. register via ml_dtypes (a jax dependency), not
+        # the numpy namespace
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def save(path: str | os.PathLike, *, fingerprint: str, pos: int,
+         chunk_counter: int, arrays: dict[str, np.ndarray],
+         extra: dict | None = None) -> str:
+    """Write a snapshot atomically (tmp + rename): a crash mid-write
+    leaves the previous snapshot (or none), never a torn file."""
+    path = os.fspath(path)
+    descs, blobs = [], []
+    for name, arr in arrays.items():
+        arr = np.ascontiguousarray(arr)
+        blob = arr.tobytes()
+        descs.append({"name": name, "dtype": str(arr.dtype),
+                      "shape": list(arr.shape), "nbytes": len(blob)})
+        blobs.append(blob)
+    meta = json.dumps({
+        "fingerprint": fingerprint, "pos": int(pos),
+        "chunk_counter": int(chunk_counter), "arrays": descs,
+        "extra": extra or {},
+    }, sort_keys=True).encode("utf-8")
+    crc = zlib.crc32(meta)
+    for blob in blobs:
+        crc = zlib.crc32(blob, crc)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(_HEADER.pack(MAGIC, len(meta), crc & 0xFFFFFFFF))
+        f.write(meta)
+        for blob in blobs:
+            f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def load(path: str | os.PathLike) -> tuple[dict, dict[str, np.ndarray]]:
+    """Load and fully validate a snapshot; returns ``(meta, arrays)``.
+
+    Raises :class:`ArtifactError` (with offset/field) on any corruption —
+    bad magic, truncation, crc mismatch, or inconsistent array
+    descriptors.  Fingerprint checking is the caller's job
+    (:meth:`Engine.restore`): only the engine knows its own shape.
+    """
+    path = os.fspath(path)
+    file_size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        head = f.read(_HEADER.size)
+        if len(head) != _HEADER.size:
+            raise ArtifactError(path, "snapshot header",
+                                "file truncated mid-field", offset=0,
+                                expected=f"{_HEADER.size} bytes",
+                                got=f"{len(head)} bytes")
+        magic, meta_len, crc_want = _HEADER.unpack(head)
+        if magic != MAGIC:
+            raise ArtifactError(path, "magic", "not a dllama snapshot",
+                                offset=0, expected=MAGIC, got=magic)
+        if not (2 <= meta_len <= min(_MAX_META, file_size)):
+            raise ArtifactError(path, "meta_len",
+                                "value out of range — corrupt snapshot",
+                                offset=8, expected=f"2..{_MAX_META}",
+                                got=meta_len)
+        body = f.read()
+    if len(body) < meta_len:
+        raise ArtifactError(path, "meta", "file truncated mid-field",
+                            offset=_HEADER.size,
+                            expected=f"{meta_len} bytes",
+                            got=f"{len(body)} bytes")
+    crc_got = zlib.crc32(body) & 0xFFFFFFFF
+    if crc_got != crc_want:
+        raise ArtifactError(path, "checksum",
+                            "checksum mismatch — snapshot bytes are corrupt",
+                            offset=_HEADER.size,
+                            expected=f"crc32={crc_want:#010x}",
+                            got=f"crc32={crc_got:#010x}")
+    try:
+        meta = json.loads(body[:meta_len].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ArtifactError(path, "meta", f"unreadable snapshot meta: {e}",
+                            offset=_HEADER.size) from e
+    for key in ("fingerprint", "pos", "chunk_counter", "arrays"):
+        if key not in meta:
+            raise ArtifactError(path, f"meta.{key}",
+                                "missing required snapshot key")
+    payload = body[meta_len:]
+    arrays: dict[str, np.ndarray] = {}
+    off = 0
+    for d in meta["arrays"]:
+        try:
+            name, nbytes = d["name"], int(d["nbytes"])
+            dt = _np_dtype(d["dtype"])
+            shape = tuple(int(s) for s in d["shape"])
+        except (KeyError, TypeError, ValueError, AttributeError) as e:
+            raise ArtifactError(path, "meta.arrays",
+                                f"bad array descriptor {d!r}: {e}") from e
+        want = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+        if nbytes != want:
+            raise ArtifactError(path, f"array {name!r}",
+                                "descriptor nbytes disagrees with dtype×shape",
+                                expected=want, got=nbytes)
+        if off + nbytes > len(payload):
+            raise ArtifactError(path, f"array {name!r}",
+                                "payload truncated",
+                                offset=_HEADER.size + meta_len + off,
+                                expected=f"{nbytes} bytes",
+                                got=f"{len(payload) - off} bytes")
+        arrays[name] = np.frombuffer(
+            payload, dtype=dt, count=int(np.prod(shape, dtype=np.int64)),
+            offset=off).reshape(shape)
+        off += nbytes
+    if off != len(payload):
+        raise ArtifactError(path, "payload",
+                            "trailing bytes after last array",
+                            offset=_HEADER.size + meta_len + off,
+                            expected="EOF", got=f"{len(payload) - off} extra bytes")
+    return meta, arrays
+
+
+def fingerprint(fields: dict) -> str:
+    """Stable short digest of an engine-shape description (truncated
+    sha256 of the sorted-key JSON)."""
+    blob = json.dumps(fields, sort_keys=True).encode("utf-8")
+    return hashlib.sha256(blob).hexdigest()[:16]

@@ -60,7 +60,8 @@ import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from ..runtime.engine import ContextOverflow, Engine, StepTimeout
+from ..io.integrity import ArtifactError, counters as integrity_counters
+from ..runtime.engine import ContextOverflow, Engine, NumericFault, StepTimeout
 from ..runtime.faults import FAULTS
 from ..runtime.stream import drain_generation
 from ..tokenizer.bpe import Tokenizer
@@ -250,8 +251,10 @@ class ApiState:
                  chunk: int = 16, model_name: str = "dllama-tpu",
                  batch_engine: Engine | None = None,
                  max_pending: int = 8, request_timeout: float = 0.0,
-                 io_timeout: float = 15.0, drain_grace: float = 30.0):
+                 io_timeout: float = 15.0, drain_grace: float = 30.0,
+                 snapshot_dir: str | None = None):
         self.engine = engine
+        self.snapshot_dir = snapshot_dir
         self.batch_engine = batch_engine
         self.tokenizer = tokenizer
         self.default_temperature = default_temperature
@@ -308,6 +311,66 @@ class ApiState:
             self.draining = True
             g = self.drain_grace if grace is None else grace
             self.drain_deadline = time.monotonic() + max(g, 0.0)
+
+    # -- engine-state snapshot (warm restart; runtime/snapshot.py) ------
+    @property
+    def snapshot_path(self) -> str | None:
+        if not self.snapshot_dir:
+            return None
+        return os.path.join(self.snapshot_dir, "engine.snap")
+
+    def save_snapshot(self) -> str | None:
+        """Snapshot the chat engine's state + the conversation cache to
+        ``--snapshot-dir`` (called after drain, when no request holds the
+        engine).  Returns the path, or None when disabled/failed — a
+        snapshot failure must never turn a clean drain into a crash."""
+        path = self.snapshot_path
+        if path is None:
+            return None
+        try:
+            os.makedirs(self.snapshot_dir, exist_ok=True)
+            with self.engine_lock:
+                cache_items = [[it.end_pos, it.message.role, it.message.content]
+                               for it in self.naive_cache.items]
+                self.engine.snapshot(path, extra={"naive_cache": cache_items})
+            print(f"🔷 engine state snapshotted to {path}")
+            return path
+        except Exception as e:
+            print(f"⚠️  snapshot failed ({e}); state not persisted")
+            return None
+
+    def restore_snapshot(self) -> bool:
+        """Warm-boot from ``--snapshot-dir`` when a snapshot exists.
+
+        The snapshot is one-shot: deleted after a successful restore so a
+        crash loop cannot replay ever-staler state.  A corrupt snapshot,
+        a config-fingerprint mismatch, or any other failure logs its
+        reason and cold-starts (the file is left behind for postmortem) —
+        never a crash; a stale state file must not take the server down."""
+        path = self.snapshot_path
+        if path is None or not os.path.exists(path):
+            return False
+        try:
+            extra = self.engine.restore(path)
+        except ArtifactError as e:
+            print(f"⚠️  snapshot rejected, cold start: {e}")
+            self.engine.reset()
+            return False
+        except Exception as e:
+            print(f"⚠️  snapshot restore failed, cold start: {e}")
+            self.engine.reset()
+            return False
+        for end_pos, role, content in extra.get("naive_cache", []):
+            self.naive_cache.push(int(end_pos), ChatMessage(str(role),
+                                                            str(content)))
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        print(f"🔷 warm start: restored engine state from {path} "
+              f"(pos={self.engine.pos}, "
+              f"{len(self.naive_cache.items)} cached messages)")
+        return True
 
     def retry_after_hint(self) -> int:
         """Retry-After seconds: queue depth × the EMA request duration
@@ -1062,7 +1125,11 @@ def make_handler(state: ApiState):
                 # for the readiness decision
                 self._json(200, state.health())
             elif self.path == "/metrics":
-                self._json(200, state.metrics.snapshot())
+                # serving counters + the process-global integrity counters
+                # (checksum_failures, numeric_faults, snapshot_restores —
+                # io/integrity.py): one scrape endpoint for both layers
+                self._json(200, {**state.metrics.snapshot(),
+                                 **integrity_counters()})
             else:
                 self._json(404, {"error": "not found"})
 
@@ -1107,6 +1174,18 @@ def make_handler(state: ApiState):
                 # generation already stopped via the abort flag
                 state.metrics.bump("client_disconnects")
                 self.close_connection = True
+            except NumericFault as e:
+                # NaN/Inf logits (--numeric-checks): the KV cache may be
+                # poisoned from the step that diverged, so resume is NOT
+                # safe — drop the conversation cache and position instead
+                # of serving garbage continuations.  The request gets a
+                # 500 (counted in numeric_faults via the engine) and the
+                # server keeps serving fresh conversations.
+                state.metrics.bump("server_errors")
+                state.naive_cache.clear()
+                state.engine.reset()
+                self._maybe_500(e)
+                raise  # surface in the server log — corruption is a page
             except Exception as e:
                 state.metrics.bump("server_errors")
                 self._maybe_500(e)
@@ -1279,6 +1358,11 @@ def serve(state: ApiState, host: str = "0.0.0.0", port: int = 9990, *,
             server.serve_forever()
         finally:
             server.server_close()
+        # after shutdown() + server_close(): in-flight requests finished,
+        # the engine is quiescent — snapshot here so the next boot is a
+        # warm start (--snapshot-dir; ApiState.restore_snapshot)
+        if state.draining:
+            state.save_snapshot()
         print("🔷 drained; bye")
     else:
         t = threading.Thread(target=server.serve_forever, daemon=True)
@@ -1317,7 +1401,10 @@ def main(argv=None):
                      max_pending=args.max_pending,
                      request_timeout=args.request_timeout,
                      io_timeout=args.io_timeout,
-                     drain_grace=args.drain_grace)
+                     drain_grace=args.drain_grace,
+                     snapshot_dir=args.snapshot_dir)
+    if args.snapshot_dir:
+        state.restore_snapshot()
     serve(state, host=args.host, port=args.port)
 
 

@@ -1,0 +1,299 @@
+"""Artifact-integrity tests: validated loaders + checksum manifests.
+
+The acceptance contract (docs/ROBUSTNESS.md): any single-byte corruption
+of a ``.m``/``.t`` header — or of any checksummed tensor region when the
+sidecar manifest is present — is rejected with an
+:class:`~dllama_tpu.io.integrity.ArtifactError` naming the file, the
+field, and the byte offset.  Never a bare ``struct.error``, never a
+silently-garbage tensor.  The fuzz tests here flip/truncate real bytes
+in real files, the way storage actually fails.
+"""
+
+import importlib.util
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from fixtures import write_tiny_model, write_tiny_tokenizer
+
+from dllama_tpu.io import integrity, mfile, tfile
+from dllama_tpu.io.integrity import ArtifactError
+
+pytestmark = pytest.mark.integrity
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def artifacts(tmp_path_factory):
+    """One tiny model + tokenizer with manifests; tests that corrupt bytes
+    take their own copies."""
+    d = tmp_path_factory.mktemp("integrity")
+    m, t = str(d / "tiny.m"), str(d / "tiny.t")
+    write_tiny_model(m)
+    write_tiny_tokenizer(t)
+    integrity.write_manifest(m)
+    integrity.write_manifest(t)
+    return m, t
+
+
+def flipped_copy(src: str, dst: str, offset: int, xor: int = 0x01) -> str:
+    shutil.copy(src, dst)
+    man_src = integrity.manifest_path_for(src)
+    if os.path.exists(man_src):
+        shutil.copy(man_src, integrity.manifest_path_for(dst))
+    with open(dst, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ xor]))
+    return dst
+
+
+def test_artifact_error_carries_structured_context():
+    e = ArtifactError("x.m", "dim", "value out of range",
+                      offset=16, expected="1..1048576", got=-3)
+    assert isinstance(e, ValueError)  # pre-integrity callers catch ValueError
+    assert (e.path, e.field, e.offset) == ("x.m", "dim", 16)
+    msg = str(e)
+    assert "x.m" in msg and "dim" in msg and "byte 16" in msg
+    assert "'1..1048576'" in msg and "-3" in msg
+
+
+def test_unknown_tensor_name_lists_known(artifacts):
+    model, _ = artifacts
+    with mfile.MFile(model) as f:
+        with pytest.raises(ArtifactError, match="unknown tensor name") as ei:
+            f.info("layers.0.bogus")
+        assert "layers.0.bogus" in str(ei.value)
+        assert "layers.0.w1" in str(ei.value)  # lists what the file has
+        # and the old KeyError contract is gone for every read path
+        with pytest.raises(ArtifactError):
+            f.tensor("nope")
+
+
+def test_mfile_header_fuzz_never_struct_error(artifacts, tmp_path):
+    """Every single-byte flip in the .m header (no manifest) parses to a
+    spec or raises ArtifactError — never struct.error or a giant alloc."""
+    import struct
+    model, _ = artifacts
+    data = bytearray(open(model, "rb").read())
+    header_size = mfile.MFile(model).spec.header_size
+    victim = str(tmp_path / "flip.m")
+    for off in range(header_size):
+        flipped = bytearray(data)
+        flipped[off] ^= 0xFF
+        with open(victim, "wb") as f:
+            f.write(flipped)
+        try:
+            mfile.read_spec(victim)
+        except ArtifactError:
+            pass
+        except struct.error as e:  # the pre-validation failure mode
+            pytest.fail(f"struct.error leaked at header byte {off}: {e}")
+
+
+def test_manifest_catches_every_header_flip(artifacts, tmp_path):
+    """With the sidecar present the header digest is always-on: ANY
+    header byte flip fails the open with ArtifactError."""
+    model, _ = artifacts
+    header_size = mfile.MFile(model).spec.header_size
+    victim = str(tmp_path / "flip.m")
+    for off in range(header_size):
+        flipped_copy(model, victim, off, xor=0xFF)
+        with pytest.raises(ArtifactError):
+            mfile.MFile(victim)
+
+
+def test_manifest_catches_tensor_flips_lazily(artifacts, tmp_path):
+    """--verify-weights: a flipped byte anywhere in a tensor region fails
+    that tensor's first read, naming the region's byte offset; untouched
+    tensors still read clean from the same corrupt file."""
+    model, _ = artifacts
+    man = integrity.load_manifest(integrity.manifest_path_for(model))
+    rng = np.random.RandomState(11)
+    names = sorted(man["tensors"])
+    clean = "token_embedding"
+    for name in rng.choice([n for n in names if n != clean], size=8,
+                           replace=False):
+        ent = man["tensors"][name]
+        off = ent["offset"] + int(rng.randint(ent["nbytes"]))
+        victim = flipped_copy(model, str(tmp_path / "flip.m"), off)
+        with mfile.MFile(victim, verify=True) as f:
+            with pytest.raises(ArtifactError) as ei:
+                f.tensor(name)
+            assert ei.value.offset == ent["offset"]
+            assert name in str(ei.value)
+            f.tensor(clean)  # untouched region verifies and decodes
+
+
+@pytest.mark.parametrize("cut", ["mid_header", "mid_tensor", "one_byte"])
+def test_truncation_rejected(artifacts, tmp_path, cut):
+    model, _ = artifacts
+    size = os.path.getsize(model)
+    keep = {"mid_header": 6, "mid_tensor": size - 100, "one_byte": size - 1}[cut]
+    victim = str(tmp_path / "trunc.m")
+    shutil.copy(model, victim)
+    with open(victim, "r+b") as f:
+        f.truncate(keep)
+    with pytest.raises(ArtifactError):
+        mfile.MFile(victim)
+
+
+def test_verify_requires_manifest(tmp_path):
+    model = str(tmp_path / "bare.m")
+    write_tiny_model(model)
+    with pytest.raises(ArtifactError, match="checksum_model"):
+        mfile.MFile(model, verify=True)
+    mfile.MFile(model)  # without verify a bare file still loads
+
+
+def test_corrupt_manifest_is_itself_an_error(artifacts, tmp_path):
+    """A manifest that cannot be parsed must not silently disable
+    verification — it is treated as corruption."""
+    model, _ = artifacts
+    victim = str(tmp_path / "m.m")
+    shutil.copy(model, victim)
+    with open(integrity.manifest_path_for(victim), "w") as f:
+        f.write('{"format": "dllama-manifest", "version": 1')  # truncated
+    with pytest.raises(ArtifactError, match="manifest"):
+        mfile.MFile(victim)
+
+
+def test_stale_manifest_detected(artifacts, tmp_path):
+    """A manifest whose byte-ranges disagree with the file's tensor plan
+    (regenerated model, stale sidecar) is rejected, not trusted."""
+    import json
+    model, _ = artifacts
+    victim = str(tmp_path / "m.m")
+    shutil.copy(model, victim)
+    man = integrity.load_manifest(integrity.manifest_path_for(model))
+    man["tensors"]["wcls"]["offset"] += 32
+    mp = integrity.manifest_path_for(victim)
+    with open(mp, "w") as f:
+        json.dump(man, f)
+    with mfile.MFile(victim, verify=True) as f:
+        with pytest.raises(ArtifactError, match="manifest"):
+            f.tensor("wcls")
+    del man["tensors"]["wcls"]
+    with open(mp, "w") as f:
+        json.dump(man, f)
+    with mfile.MFile(victim, verify=True) as f:
+        with pytest.raises(ArtifactError, match="manifest"):
+            f.tensor("wcls")
+
+
+def test_io_read_tensor_fault_point(artifacts, tmp_path):
+    """The io.read_tensor=corrupt fault flips a byte in the read buffer;
+    under --verify-weights the checksum catches the injected corruption."""
+    from dllama_tpu.runtime.faults import injected
+    model, _ = artifacts
+    victim = str(tmp_path / "m.m")
+    shutil.copy(model, victim)
+    shutil.copy(integrity.manifest_path_for(model),
+                integrity.manifest_path_for(victim))
+    integrity.reset_counters()
+    with injected("io.read_tensor=corruptx1"):
+        with mfile.MFile(victim, verify=True) as f:
+            with pytest.raises(ArtifactError, match="checksum mismatch"):
+                f.tensor("token_embedding")
+            f.tensor("token_embedding")  # fault disarmed: reads clean
+    assert integrity.counters()["checksum_failures"] == 1
+
+
+def test_lazy_verification_runs_once(artifacts):
+    model, _ = artifacts
+    integrity.reset_counters()
+    with mfile.MFile(model, verify=True) as f:  # header verifies at open
+        f.tensor("wcls")
+        f.tensor("wcls")  # second read: already-verified region, no re-crc
+    assert integrity.counters()["checksum_verified"] == 2  # header + wcls
+    assert integrity.counters()["checksum_failures"] == 0
+
+
+def test_tfile_fuzz_with_manifest(artifacts, tmp_path):
+    """The tokenizer manifest is a whole-file digest: a flip ANYWHERE in
+    the .t (header, scores, token bytes) fails the load."""
+    _, tok = artifacts
+    size = os.path.getsize(tok)
+    rng = np.random.RandomState(5)
+    offsets = {0, 7, size - 1} | {int(o) for o in rng.randint(size, size=20)}
+    victim = str(tmp_path / "flip.t")
+    for off in sorted(offsets):
+        flipped_copy(tok, victim, off)
+        with pytest.raises(ArtifactError):
+            tfile.read_tfile(victim)
+
+
+def test_tfile_structural_fuzz_no_manifest(tmp_path):
+    """Without a manifest the .t parser is still fully bounds-checked:
+    header flips either parse or raise ArtifactError, never struct.error,
+    and truncation is always caught."""
+    import struct
+    tok = str(tmp_path / "tok.t")
+    write_tiny_tokenizer(tok)
+    data = bytearray(open(tok, "rb").read())
+    victim = str(tmp_path / "flip.t")
+    for off in range(min(len(data), 96)):
+        flipped = bytearray(data)
+        flipped[off] ^= 0xFF
+        with open(victim, "wb") as f:
+            f.write(flipped)
+        try:
+            tfile.read_tfile(victim)
+        except (ArtifactError, ValueError):
+            pass
+        except struct.error as e:
+            pytest.fail(f"struct.error leaked at tokenizer byte {off}: {e}")
+    for keep in (3, 17, len(data) - 1):
+        with open(victim, "wb") as f:
+            f.write(data[:keep])
+        with pytest.raises((ArtifactError, ValueError)):
+            tfile.read_tfile(victim)
+
+
+def _load_checksum_tool():
+    spec = importlib.util.spec_from_file_location(
+        "checksum_model", os.path.join(REPO, "tools", "checksum_model.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_checksum_tool_write_verify_corrupt(tmp_path, capsys):
+    tool = _load_checksum_tool()
+    model = str(tmp_path / "m.m")
+    write_tiny_model(model)
+    assert tool.main(["write", model]) == 0
+    assert os.path.exists(integrity.manifest_path_for(model))
+    assert tool.main(["verify", model]) == 0
+    man = integrity.load_manifest(integrity.manifest_path_for(model))
+    ent = man["tensors"]["wcls"]
+    with open(model, "r+b") as f:
+        f.seek(ent["offset"] + 3)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0x01]))
+    assert tool.main(["verify", model]) == 1
+    out = capsys.readouterr().out
+    assert "wcls" in out and "checksum mismatch" in out
+    assert tool.main(["verify", str(tmp_path / "missing.m")]) == 1
+
+
+def test_verify_file_counts_regions(artifacts):
+    model, tok = artifacts
+    man = integrity.load_manifest(integrity.manifest_path_for(model))
+    assert integrity.verify_file(model) == 1 + len(man["tensors"])
+    assert integrity.verify_file(tok) == 1  # whole-file digest
+
+
+def test_counters_seeded_from_boot():
+    """Every exported counter key exists before any failure — a missing
+    metric reads as "missing" to a dashboard, not "zero"."""
+    integrity.reset_counters()
+    c = integrity.counters()
+    assert set(c) >= {"checksum_verified", "checksum_failures",
+                      "numeric_faults", "snapshot_restores"}
+    assert all(v == 0 for v in c.values())

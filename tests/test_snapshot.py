@@ -1,0 +1,227 @@
+"""Engine snapshot/restore tests.
+
+The contract (docs/ROBUSTNESS.md): after ``Engine.restore()`` the
+continued decode stream is *token-identical* to an engine that never
+restarted — KV cache, position clock, sampler RNG stream, and ragged
+offsets all come back exactly.  And the failure half: a corrupt,
+truncated, or differently-configured snapshot raises
+:class:`ArtifactError`/:class:`SnapshotMismatch` — the server's boot
+path turns that into a logged cold start, never a crash.
+"""
+
+import numpy as np
+import pytest
+import jax
+
+from dllama_tpu.io.integrity import ArtifactError, counters, reset_counters
+from dllama_tpu.models.config import tiny_config
+from dllama_tpu.models.params import init_params
+from dllama_tpu.parallel.mesh import make_mesh
+from dllama_tpu.runtime import snapshot as snapfmt
+from dllama_tpu.runtime.engine import Engine, NumericFault
+from dllama_tpu.runtime.snapshot import SnapshotMismatch
+
+pytestmark = pytest.mark.integrity
+
+CFG = tiny_config(seq_len=64)
+
+
+def make_engine(cfg=CFG, seed=4, **kw):
+    return Engine(cfg, init_params(cfg, seed=seed),
+                  mesh=make_mesh(tp=1, devices=jax.devices()[:1]), **kw)
+
+
+def turn(eng, prompt, seed, n=10):
+    """One sampled chat turn; seed=None continues the RNG stream."""
+    return [t for t, _ in eng.generate_stream(
+        prompt, n, temperature=0.8, seed=seed, chunk=4)]
+
+
+def test_roundtrip_token_identical(tmp_path):
+    """Restore → continued decode matches the uninterrupted engine token
+    for token, including the cross-turn RNG stream (seed=None)."""
+    path = str(tmp_path / "engine.snap")
+    e1 = make_engine()
+    turn(e1, [3, 4, 1], seed=7)
+    e1.snapshot(path, extra={"note": "turn-1"})
+    pos_at_snapshot = e1.pos
+    uninterrupted = turn(e1, [8, 2], seed=None)
+
+    e2 = make_engine()  # same params, fresh state
+    extra = e2.restore(path)
+    assert extra["note"] == "turn-1"
+    assert e2.pos == pos_at_snapshot
+    restored = turn(e2, [8, 2], seed=None)
+    assert restored == uninterrupted
+
+
+def test_roundtrip_quantized_cache(tmp_path):
+    """A q8 KV cache snapshots all four arrays (values + scales) and
+    restores token-identically."""
+    path = str(tmp_path / "q8.snap")
+    e1 = make_engine(kv_dtype="q8")
+    assert e1.cache.quantized
+    turn(e1, [5, 9, 2], seed=3)
+    e1.snapshot(path)
+    scales_at_snapshot = np.asarray(e1.cache.k_scale).copy()
+    uninterrupted = turn(e1, [7], seed=None)
+    e2 = make_engine(kv_dtype="q8")
+    e2.restore(path)
+    np.testing.assert_array_equal(np.asarray(e2.cache.k_scale),
+                                  scales_at_snapshot)
+    assert turn(e2, [7], seed=None) == uninterrupted
+
+
+def test_fingerprint_mismatch_cold_start(tmp_path):
+    """A snapshot from a differently-shaped engine is refused with
+    SnapshotMismatch (an ArtifactError → the server cold-starts)."""
+    path = str(tmp_path / "engine.snap")
+    e1 = make_engine(cfg=tiny_config(seq_len=32))
+    turn(e1, [3], seed=1)
+    e1.snapshot(path)
+    e2 = make_engine(cfg=tiny_config(seq_len=64))
+    with pytest.raises(SnapshotMismatch, match="differently-configured"):
+        e2.restore(path)
+    assert isinstance(SnapshotMismatch(path, "x", "y"), ArtifactError)
+    assert e2.pos == 0  # engine untouched by the refused restore
+
+
+def test_quantized_vs_dense_layout_mismatch(tmp_path):
+    """Cache layout is part of the fingerprint: a dense snapshot cannot
+    restore into a q8 engine."""
+    path = str(tmp_path / "dense.snap")
+    e1 = make_engine()
+    e1.snapshot(path)
+    with pytest.raises(SnapshotMismatch):
+        make_engine(kv_dtype="q8").restore(path)
+
+
+def test_corrupt_snapshot_rejected(tmp_path):
+    """Any single-byte flip fails the load's crc32 (covers meta AND
+    payload) with an ArtifactError naming the field."""
+    path = str(tmp_path / "engine.snap")
+    e = make_engine()
+    turn(e, [3, 4], seed=2)
+    e.snapshot(path)
+    data = bytearray(open(path, "rb").read())
+    rng = np.random.RandomState(9)
+    for off in sorted({0, 9, len(data) - 1} |
+                      {int(o) for o in rng.randint(len(data), size=12)}):
+        flipped = bytearray(data)
+        flipped[off] ^= 0x10
+        bad = str(tmp_path / "bad.snap")
+        with open(bad, "wb") as f:
+            f.write(flipped)
+        with pytest.raises(ArtifactError):
+            make_engine().restore(bad)
+
+
+def test_truncated_snapshot_rejected(tmp_path):
+    path = str(tmp_path / "engine.snap")
+    e = make_engine()
+    e.snapshot(path)
+    data = open(path, "rb").read()
+    for keep in (0, 7, 13, len(data) // 2, len(data) - 1):
+        bad = str(tmp_path / "trunc.snap")
+        with open(bad, "wb") as f:
+            f.write(data[:keep])
+        with pytest.raises(ArtifactError):
+            make_engine().restore(bad)
+
+
+def test_pos_out_of_range_rejected(tmp_path):
+    """A forged-but-checksummed snapshot with pos past the context window
+    is refused (defense against a stale snapshot from a longer run)."""
+    e = make_engine()
+    arrays = {n: np.asarray(a) for n, a in e._cache_arrays().items()}
+    arrays["rng_key"] = np.asarray(e._key)
+    path = str(tmp_path / "forged.snap")
+    snapfmt.save(path, fingerprint=e.config_fingerprint(),
+                 pos=e.seq_len + 1, chunk_counter=0, arrays=arrays)
+    with pytest.raises(SnapshotMismatch, match="position"):
+        e.restore(path)
+
+
+def test_missing_cache_array_rejected(tmp_path):
+    e = make_engine()
+    path = str(tmp_path / "partial.snap")
+    snapfmt.save(path, fingerprint=e.config_fingerprint(), pos=0,
+                 chunk_counter=0,
+                 arrays={"cache.k": np.asarray(e.cache.k),
+                         "rng_key": np.asarray(e._key)})
+    with pytest.raises(SnapshotMismatch, match="cache.v"):
+        e.restore(path)
+
+
+def test_restore_counter_exported(tmp_path):
+    reset_counters()
+    e = make_engine()
+    path = str(tmp_path / "engine.snap")
+    e.snapshot(path)
+    make_engine().restore(path)
+    assert counters()["snapshot_restores"] == 1
+
+
+def test_numeric_guard_raises_on_injected_nan():
+    """--numeric-checks: the engine.numeric=nan fault poisons the host
+    logits and the guard raises NumericFault naming step and pos —
+    instead of sampling garbage tokens from NaN logits."""
+    from dllama_tpu.runtime.faults import injected
+    reset_counters()
+    e = make_engine(numeric_checks=True)
+    with injected("engine.numeric=nanx1"):
+        with pytest.raises(NumericFault, match="pos=") as ei:
+            e.prefill([3, 4, 1])
+        assert ei.value.step == "prefill"
+    assert counters()["numeric_faults"] == 1
+    e.reset()
+    toks = turn(e, [3, 4, 1], seed=7)  # disarmed: decodes normally
+    assert len(toks) == 10
+
+
+def test_numeric_guard_off_by_default():
+    from dllama_tpu.runtime.faults import injected
+    e = make_engine()
+    assert not e.numeric_checks
+    with injected("engine.numeric=nanx1"):
+        e.prefill([3])  # unchecked: the fault point is never consulted
+
+
+def test_server_restore_snapshot_cold_start_paths(tmp_path):
+    """ApiState.restore_snapshot: warm start on a good snapshot (one-shot
+    file), logged cold start — not a crash — on a corrupt one."""
+    import os
+
+    from fixtures import write_tiny_tokenizer
+
+    from dllama_tpu.server.api import ApiState
+    from dllama_tpu.tokenizer.bpe import Tokenizer
+
+    tok = Tokenizer(write_tiny_tokenizer(str(tmp_path / "tok.t")))
+    cfg = tiny_config(seq_len=64, vocab_size=300)
+    snap_dir = str(tmp_path / "snaps")
+
+    eng = make_engine(cfg=cfg)
+    state = ApiState(eng, tok, snapshot_dir=snap_dir)
+    assert state.restore_snapshot() is False  # nothing to restore yet
+    turn(eng, [3, 4], seed=5)
+    assert state.save_snapshot() == state.snapshot_path
+
+    eng2 = make_engine(cfg=cfg)
+    state2 = ApiState(eng2, tok, snapshot_dir=snap_dir)
+    assert state2.restore_snapshot() is True
+    assert eng2.pos == eng.pos
+    assert not os.path.exists(state2.snapshot_path)  # one-shot
+
+    # corrupt snapshot → cold start, file kept for postmortem
+    state.save_snapshot()
+    with open(state.snapshot_path, "r+b") as f:
+        f.seek(20)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    eng3 = make_engine(cfg=cfg)
+    state3 = ApiState(eng3, tok, snapshot_dir=snap_dir)
+    assert state3.restore_snapshot() is False
+    assert eng3.pos == 0
+    assert os.path.exists(state3.snapshot_path)

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Write or verify checksum manifests for dllama artifacts.
+
+A manifest is a JSON sidecar (``<artifact>.sum``) carrying a crc32 per
+tensor byte-range (for ``.m`` model files) or a whole-file digest (for
+``.t`` tokenizers and anything else), plus a header digest and the file
+size — see dllama_tpu/io/integrity.py for the format.  With a manifest
+present, ``MFile`` always verifies the header at open and verifies each
+tensor on first read under ``--verify-weights``; ``read_tfile`` verifies
+the whole file.
+
+Usage::
+
+    python tools/checksum_model.py write  model.m [tokenizer.t ...]
+    python tools/checksum_model.py verify model.m [tokenizer.t ...]
+    python tools/checksum_model.py write  legacy.m --weights-float-type q40
+
+``write`` computes digests and writes the sidecar atomically.  ``verify``
+re-reads every manifested region and exits non-zero on the first
+mismatch, printing the ArtifactError (file, field, byte offset,
+expected-vs-got crc32).  ``--weights-float-type`` is only needed for
+legacy ``.m`` files whose header predates the weights-float-type key.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # dllama_tpu (running from a checkout)
+
+from dllama_tpu.io import integrity  # noqa: E402
+from dllama_tpu.io.integrity import ArtifactError  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("command", choices=("write", "verify"))
+    ap.add_argument("artifacts", nargs="+",
+                    help="model (.m) / tokenizer (.t) files")
+    ap.add_argument("--weights-float-type", default=None,
+                    help="weight float type for legacy .m headers that "
+                         "omit it (e.g. q40, q80, f32)")
+    args = ap.parse_args(argv)
+
+    wft = None
+    if args.weights_float_type:
+        from dllama_tpu.models import quants
+        wft = quants.FLOAT_TYPE_BY_NAME[args.weights_float_type]
+
+    rc = 0
+    for path in args.artifacts:
+        if not os.path.exists(path):
+            print(f"❌ {path}: no such file")
+            rc = 1
+            continue
+        try:
+            if args.command == "write":
+                mp = integrity.write_manifest(path, weights_ftype=wft)
+                man = integrity.load_manifest(mp)
+                n = 1 + len(man["tensors"])
+                print(f"✅ {path}: wrote {mp} ({n} region(s), "
+                      f"{man['file_size']} bytes covered)")
+            else:
+                n = integrity.verify_file(path)
+                print(f"✅ {path}: {n} region(s) verified")
+        except ArtifactError as e:
+            print(f"❌ {e}")
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -210,12 +210,81 @@ def drill_drain(model, tok):
         s.stop()
 
 
+def drill_corruption(model, tok):
+    """A bit-flipped weight under --verify-weights → the server refuses to
+    boot with a checksum ArtifactError; the pristine copy boots fine."""
+    import shutil
+
+    from dllama_tpu.io import integrity
+    with tempfile.TemporaryDirectory() as d:
+        bad = os.path.join(d, "bad.m")
+        shutil.copy(model, bad)
+        integrity.write_manifest(bad)
+        man = integrity.load_manifest(integrity.manifest_path_for(bad))
+        ent = next(iter(man["tensors"].values()))
+        with open(bad, "r+b") as f:  # flip one bit inside the first tensor
+            f.seek(ent["offset"] + ent["nbytes"] // 2)
+            b = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([b[0] ^ 0x01]))
+        s = Server(bad, tok, extra_flags=["--verify-weights"])
+        try:
+            rc = s.proc.wait(timeout=240)
+            out = s.proc.stdout.read()
+            assert rc != 0, "server must refuse a corrupt model"
+            assert "checksum mismatch" in out, out[-2000:]
+        finally:
+            s.stop()
+        # the same flags on an intact artifact serve normally
+        good = os.path.join(d, "good.m")
+        shutil.copy(model, good)
+        integrity.write_manifest(good)
+        s = Server(good, tok, extra_flags=["--verify-weights"])
+        try:
+            s.wait_ready()
+            with post(s.base, BODY) as r:
+                data = json.loads(r.read())
+            assert data["choices"][0]["finish_reason"] == "stop", data
+            assert get(s.base, "/metrics")["checksum_verified"] >= 1
+        finally:
+            s.stop()
+
+
+def drill_snapshot_restart(model, tok):
+    """SIGTERM with --snapshot-dir → state snapshots on drain; the next
+    boot warm-starts from it (one-shot) and serves normally."""
+    with tempfile.TemporaryDirectory() as d:
+        snap = os.path.join(d, "engine.snap")
+        s = Server(model, tok, extra_flags=["--snapshot-dir", d])
+        try:
+            s.wait_ready()
+            with post(s.base, BODY) as r:
+                json.loads(r.read())
+            s.proc.send_signal(signal.SIGTERM)
+            assert s.proc.wait(timeout=120) == 0, "drain must exit cleanly"
+            assert os.path.exists(snap), "drain must write the snapshot"
+        finally:
+            s.stop()
+        s = Server(model, tok, extra_flags=["--snapshot-dir", d])
+        try:
+            s.wait_ready()
+            assert get(s.base, "/metrics")["snapshot_restores"] == 1
+            assert not os.path.exists(snap), "restore must be one-shot"
+            with post(s.base, BODY) as r:  # serves normally after restore
+                data = json.loads(r.read())
+            assert data["choices"][0]["finish_reason"] == "stop", data
+        finally:
+            s.stop()
+
+
 DRILLS = {
     "deadline": drill_deadline,
     "disconnect": drill_disconnect,
     "read_timeout": drill_read_timeout,
     "backpressure": drill_backpressure,
     "drain": drill_drain,
+    "corruption": drill_corruption,
+    "snapshot_restart": drill_snapshot_restart,
 }
 
 
