@@ -416,6 +416,16 @@ def _bench_decode(cfg, chunk=32, n_chunks=10, profile=False, start_pos=0,
             np.asarray(toks)
 
         _profile_split_stderr(run_once, chunk)
+
+    # feed the timed chunks into the obs step-latency histogram and log
+    # the distribution (stderr) — same buckets the serving layer exports,
+    # so a bench number and a /metrics scrape are directly comparable
+    from dllama_tpu.obs import metrics as obs_metrics
+    for t in times:
+        obs_metrics.ENGINE_GENERATION_MS.observe(t)
+    h = obs_metrics.ENGINE_GENERATION_MS.json_value()
+    print(f"bench: per-token ms distribution: count={h['count']} "
+          f"avg={h['avg']:.3f} (dllama_engine_generation_ms)", file=sys.stderr)
     return float(np.mean(times))
 
 
@@ -459,6 +469,11 @@ def _bench_prefill(cfg, T=512, reps=6):
 
 def run_attempt(name):
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    # bench children log like the server does (DLLAMA_LOG honored); all
+    # dllama logging goes to stderr, so the one-JSON-line stdout contract
+    # is untouched
+    from dllama_tpu.obs.log import configure as _configure_logging
+    _configure_logging()
     import jax
 
     if name == "probe":

@@ -183,6 +183,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "here and the next boot warm-starts from it "
                         "(validated: a corrupt or mismatched snapshot "
                         "cold-starts with a logged reason)")
+    # ---- observability (docs/OBSERVABILITY.md) ----
+    p.add_argument("--log-format", choices=["human", "json"], default=None,
+                   help="log output format: human-readable lines or JSON "
+                        "lines (one object per record, grep-able by "
+                        "request_id).  Default: DLLAMA_LOG env, else human")
+    p.add_argument("--log-level", default=None,
+                   choices=["debug", "info", "warning", "error"],
+                   help="log verbosity for the dllama logger tree "
+                        "(default: DLLAMA_LOG env, else info)")
     return p
 
 
@@ -474,6 +483,8 @@ WORKER_PROGRAMS = {"generate": cmd_generate, "inference": cmd_inference,
 
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
+    from .obs.log import configure as configure_logging
+    configure_logging(args.log_format, args.log_level)
     from .parallel.distributed import distributed_env, init_distributed
     if args.coordinator or distributed_env() is not None:
         init_distributed(args.coordinator, args.nproc, args.proc_id)

@@ -29,7 +29,6 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import zlib
 
 MANIFEST_FORMAT = "dllama-manifest"
@@ -63,31 +62,35 @@ class ArtifactError(ValueError):
 
 
 # -- verification counters (exported at /metrics) -------------------------
+# Since PR 3 these live in the obs metric registry (one registry, two
+# exposition formats — see dllama_tpu/obs/metrics.py); the three
+# functions below keep the pre-registry call-site API.  Registered at
+# obs import, so every key is present from boot (a counter that appears
+# only after its first failure reads as "metric missing" to a dashboard,
+# not "zero failures").
 
-_counter_lock = threading.Lock()
-#: seeded with every counter /metrics exports so the keys are present
-#: from boot (a counter that appears only after its first failure reads
-#: as "metric missing" to a dashboard, not "zero failures")
-_counters = {"checksum_verified": 0, "checksum_failures": 0,
-             "numeric_faults": 0, "snapshot_restores": 0}
+_INTEGRITY_KEYS = ("checksum_verified", "checksum_failures",
+                   "numeric_faults", "snapshot_restores")
+
+
+def _counter(name: str):
+    from dllama_tpu.obs import metrics as _m
+    return _m.REGISTRY.counter(name)
 
 
 def bump_counter(name: str, n: int = 1) -> None:
-    with _counter_lock:
-        _counters[name] = _counters.get(name, 0) + n
+    _counter(name).inc(n)
 
 
 def counters() -> dict:
     """Snapshot of the process-global verification counters."""
-    with _counter_lock:
-        return dict(_counters)
+    return {k: _counter(k).value for k in _INTEGRITY_KEYS}
 
 
 def reset_counters() -> None:
     """Test isolation helper."""
-    with _counter_lock:
-        for k in _counters:
-            _counters[k] = 0
+    for k in _INTEGRITY_KEYS:
+        _counter(k).reset()
 
 
 # -- digests ---------------------------------------------------------------

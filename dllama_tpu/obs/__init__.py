@@ -1,0 +1,25 @@
+"""Unified observability for the serving stack (docs/OBSERVABILITY.md).
+
+Three stdlib-only building blocks, threaded through every layer:
+
+* :mod:`.metrics` — THE process-global registry of counters, gauges and
+  fixed-bucket histograms, with two exposition paths from the one
+  registry: the backward-compatible ``/metrics`` JSON dict and
+  Prometheus text format 0.0.4.
+* :mod:`.log` — structured logging (JSON lines or human format) with a
+  contextvar-carried request ID stamped on every record, so one grep of
+  the server log reconstructs a request's full lifecycle across server,
+  engine, fault and snapshot code.
+* :mod:`.trace` — lightweight always-on in-process spans in a bounded
+  ring buffer, dumpable as Chrome ``trace_event`` JSON (``/debug/trace``
+  + ``tools/trace_dump.py``); the cheap first-line latency attribution
+  next to the heavyweight XLA tracer (``runtime/profiling.py``).
+
+Nothing here imports jax (or anything beyond the stdlib): the engine,
+loaders, and server all import ``obs`` freely with no cycle risk, and a
+metric bump on the decode hot path costs one small lock.
+"""
+
+from __future__ import annotations
+
+from . import log, metrics, trace  # noqa: F401

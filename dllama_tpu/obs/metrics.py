@@ -1,0 +1,374 @@
+"""Process-global metric registry with dual exposition (JSON + Prometheus).
+
+One :class:`Registry` instance (:data:`REGISTRY`) is the single source of
+truth for every counter, gauge, and histogram in the process — the
+serving layer's ``ServerMetrics``, ``io/integrity.py``'s verification
+counters, and the engine's step-latency histograms all register here.
+Two exposition paths read the same registry:
+
+* :func:`snapshot_json` — the ``/metrics`` JSON dict.  Backward
+  compatible: every pre-registry key (``requests_served``, ``uptime_s``,
+  ``checksum_failures``, ...) keeps its name and flat-int shape, and the
+  counters are *seeded at import* so a dashboard never confuses "metric
+  missing" with "zero".  Histograms appear as ``{"count", "sum", "avg",
+  "buckets": {le: cumulative_count}}`` objects under new keys, plus a
+  ``schema_version`` field.  Merging serving and integrity counters
+  through one registry also fixes the old ``{**a, **b}`` exposure, where
+  a key collision silently dropped a counter — here a name collision is
+  a registration-time :class:`ValueError`.
+* :func:`render_prometheus` — text exposition format 0.0.4 (``# HELP`` /
+  ``# TYPE`` lines; histogram ``_bucket{le=...}`` / ``_sum`` /
+  ``_count`` series with cumulative buckets), scrapeable by an
+  off-the-shelf Prometheus at ``GET /metrics`` with ``Accept:
+  text/plain`` (server/api.py negotiates).
+
+Everything is thread-safe (one small lock per metric; the threaded API
+server bumps from request threads while scrapes snapshot concurrently)
+and stdlib-only.  See docs/OBSERVABILITY.md for the metric catalog.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+
+#: bumped when a key changes meaning or shape in the JSON exposition
+SCHEMA_VERSION = 2
+
+
+def _fmt(v: float) -> str:
+    """Prometheus-style number rendering: integral values print without a
+    trailing ``.0`` (``le="2.5"`` but ``le="1"``), everything else as the
+    shortest round-tripping float."""
+    f = float(v)
+    if f == float("inf"):
+        return "+Inf"
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+class Counter:
+    """Monotonically increasing count."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, json_key: str, help: str = ""):
+        self.name = name
+        self.json_key = json_key
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+    def json_value(self):
+        return self.value
+
+    def render(self, lines: list[str]) -> None:
+        if self.help:
+            lines.append(f"# HELP {self.name} {_escape_help(self.help)}")
+        lines.append(f"# TYPE {self.name} counter")
+        lines.append(f"{self.name} {_fmt(self.value)}")
+
+
+class Gauge:
+    """A value that goes up and down (or is computed at read time via
+    ``fn`` — e.g. uptime)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, json_key: str, help: str = "", fn=None):
+        self.name = name
+        self.json_key = json_key
+        self.help = help
+        self.fn = fn
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def add(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        if self.fn is not None:
+            return float(self.fn())
+        with self._lock:
+            return self._value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
+    def json_value(self):
+        return round(self.value, 6)
+
+    def render(self, lines: list[str]) -> None:
+        if self.help:
+            lines.append(f"# HELP {self.name} {_escape_help(self.help)}")
+        lines.append(f"# TYPE {self.name} gauge")
+        lines.append(f"{self.name} {_fmt(self.value)}")
+
+
+class Histogram:
+    """Fixed-bucket histogram (Prometheus semantics: cumulative buckets,
+    an implicit ``+Inf`` bucket, ``sum`` and ``count`` series).
+
+    Buckets are chosen at registration and never change — fixed buckets
+    make ``observe`` an O(log n_buckets) bisect plus two adds under one
+    lock, cheap enough for the per-token emit path."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, json_key: str, buckets, help: str = ""):
+        ups = sorted(float(b) for b in buckets)
+        if not ups:
+            raise ValueError(f"histogram {name!r} needs at least one bucket")
+        self.name = name
+        self.json_key = json_key
+        self.help = help
+        self.uppers = tuple(ups)
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(ups) + 1)  # last slot = +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        i = bisect.bisect_left(self.uppers, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self) -> tuple[list[int], float, int]:
+        """(cumulative_counts incl. +Inf, sum, count) — one consistent
+        view (a concurrent ``observe`` lands wholly before or after)."""
+        with self._lock:
+            raw = list(self._counts)
+            total, count = self._sum, self._count
+        cum, acc = [], 0
+        for c in raw:
+            acc += c
+            cum.append(acc)
+        return cum, total, count
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * len(self._counts)
+            self._sum = 0.0
+            self._count = 0
+
+    def json_value(self):
+        cum, total, count = self.snapshot()
+        labels = [_fmt(u) for u in self.uppers] + ["+Inf"]
+        return {"count": count, "sum": round(total, 6),
+                "avg": round(total / count, 6) if count else 0.0,
+                "buckets": dict(zip(labels, cum))}
+
+    def render(self, lines: list[str]) -> None:
+        if self.help:
+            lines.append(f"# HELP {self.name} {_escape_help(self.help)}")
+        lines.append(f"# TYPE {self.name} histogram")
+        cum, total, count = self.snapshot()
+        for upper, c in zip(list(self.uppers) + [float("inf")], cum):
+            lines.append(f'{self.name}_bucket{{le="{_fmt(upper)}"}} {c}')
+        lines.append(f"{self.name}_sum {_fmt(round(total, 9))}")
+        lines.append(f"{self.name}_count {count}")
+
+
+class Registry:
+    """Named metric collection with get-or-create registration.
+
+    ``json_key`` is the flat key in the JSON exposition (the pre-registry
+    ``/metrics`` names); the Prometheus ``name`` derives from it
+    (``dllama_<key>`` + ``_total`` for counters) unless given explicitly
+    — e.g. when the JSON key predates unit-suffix conventions."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_name: dict[str, object] = {}
+        self._by_json: dict[str, object] = {}
+        self.started_at = time.time()
+
+    def _register(self, cls, json_key: str, name: str | None, args, kwargs):
+        name = name or ("dllama_" + json_key
+                        + ("_total" if cls is Counter else ""))
+        with self._lock:
+            existing = self._by_json.get(json_key) or self._by_name.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls):
+                    raise ValueError(
+                        f"metric {json_key!r} already registered as "
+                        f"{existing.kind}, not {cls.kind}")
+                return existing
+            m = cls(name, json_key, *args, **kwargs)
+            self._by_name[name] = m
+            self._by_json[json_key] = m
+            return m
+
+    def counter(self, json_key: str, help: str = "",
+                name: str | None = None) -> Counter:
+        return self._register(Counter, json_key, name, (help,), {})
+
+    def gauge(self, json_key: str, help: str = "", name: str | None = None,
+              fn=None) -> Gauge:
+        return self._register(Gauge, json_key, name, (help,), {"fn": fn})
+
+    def histogram(self, json_key: str, buckets, help: str = "",
+                  name: str | None = None) -> Histogram:
+        return self._register(Histogram, json_key, name, (buckets, help), {})
+
+    def metrics(self) -> list:
+        with self._lock:
+            return list(self._by_name.values())
+
+    def snapshot_json(self) -> dict:
+        out = {"schema_version": SCHEMA_VERSION,
+               "uptime_s": round(time.time() - self.started_at, 3)}
+        for m in self.metrics():
+            out[m.json_key] = m.json_value()
+        return out
+
+    def render_prometheus(self) -> str:
+        lines = [
+            "# HELP dllama_uptime_seconds Seconds since process metrics init.",
+            "# TYPE dllama_uptime_seconds gauge",
+            f"dllama_uptime_seconds {_fmt(round(time.time() - self.started_at, 3))}",
+        ]
+        for m in self.metrics():
+            m.render(lines)
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        """Zero every metric (test isolation; registration survives)."""
+        for m in self.metrics():
+            m.reset()
+
+
+#: THE process-global registry both exposition paths read.
+REGISTRY = Registry()
+
+
+def snapshot_json() -> dict:
+    return REGISTRY.snapshot_json()
+
+
+def render_prometheus() -> str:
+    return REGISTRY.render_prometheus()
+
+
+# -- standard buckets ------------------------------------------------------
+# Latency buckets span cold-compile tails (a first request on CPU can take
+# tens of seconds) down to sub-ms steady-state inter-token gaps.
+TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+INTER_TOKEN_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                       0.1, 0.25, 0.5, 1.0, 2.5)
+DURATION_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                    10.0, 30.0, 60.0, 120.0, 300.0)
+STEP_MS_BUCKETS = (0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+                   1000, 2500, 5000)
+BYTES_BUCKETS = (256, 1024, 4096, 16384, 65536, 262144,
+                 1048576, 4194304, 16777216)
+
+
+# -- standard metrics, seeded at import ------------------------------------
+# Seeding keeps every exported key present from boot (a counter that
+# appears only after its first event reads as "metric missing" to a
+# dashboard, not "zero") and gives call sites module-level handles with
+# no per-call registry lookup.
+
+# serving counters (server/api.py ServerMetrics is a view over these)
+REQUESTS_SERVED = REGISTRY.counter(
+    "requests_served", "Requests completed successfully.")
+REQUESTS_REJECTED_429 = REGISTRY.counter(
+    "requests_rejected_429", "Requests rejected by bounded admission.")
+REQUESTS_REJECTED_503 = REGISTRY.counter(
+    "requests_rejected_503", "Requests rejected while draining.")
+READ_TIMEOUTS_408 = REGISTRY.counter(
+    "read_timeouts_408", "Request bodies that stalled past --io-timeout.")
+DEADLINE_TIMEOUTS = REGISTRY.counter(
+    "deadline_timeouts", "Requests truncated by their deadline.")
+CLIENT_DISCONNECTS = REGISTRY.counter(
+    "client_disconnects", "Clients that vanished mid-request.")
+SERVER_ERRORS = REGISTRY.counter(
+    "server_errors", "Requests that failed with a 500.")
+
+# artifact-integrity counters (io/integrity.py delegates here)
+CHECKSUM_VERIFIED = REGISTRY.counter(
+    "checksum_verified", "Artifact regions whose crc32 verified clean.")
+CHECKSUM_FAILURES = REGISTRY.counter(
+    "checksum_failures", "Artifact regions whose crc32 mismatched.")
+NUMERIC_FAULTS = REGISTRY.counter(
+    "numeric_faults", "NaN/Inf logits caught by --numeric-checks.")
+SNAPSHOT_RESTORES = REGISTRY.counter(
+    "snapshot_restores", "Engine warm starts restored from a snapshot.")
+
+# gauges
+AVG_REQUEST_S = REGISTRY.gauge(
+    "avg_request_s", "EMA request duration (feeds Retry-After).",
+    name="dllama_request_duration_ema_seconds")
+
+# request-path histograms (server/api.py)
+TTFT = REGISTRY.histogram(
+    "ttft_seconds", TTFT_BUCKETS,
+    "Time from request admission to the first emitted delta.")
+INTER_TOKEN = REGISTRY.histogram(
+    "inter_token_seconds", INTER_TOKEN_BUCKETS,
+    "Gap between consecutive emitted deltas of one request.")
+QUEUE_WAIT = REGISTRY.histogram(
+    "queue_wait_seconds", TTFT_BUCKETS,
+    "Time an admitted request waited for the engine mutex.")
+REQUEST_DURATION = REGISTRY.histogram(
+    "request_duration_seconds", DURATION_BUCKETS,
+    "Whole-request wall time, admission to completion.")
+
+# engine-step histograms (runtime/engine.py; reference G/I/T contract —
+# per-token values, chunk averages for the on-device chunked decode)
+ENGINE_GENERATION_MS = REGISTRY.histogram(
+    "engine_generation_ms", STEP_MS_BUCKETS,
+    "Per-token whole-step wall time (G), milliseconds.")
+ENGINE_INFERENCE_MS = REGISTRY.histogram(
+    "engine_inference_ms", STEP_MS_BUCKETS,
+    "Per-token device execution time (I), milliseconds.")
+ENGINE_TRANSFER_MS = REGISTRY.histogram(
+    "engine_transfer_ms", STEP_MS_BUCKETS,
+    "Per-token host<->device boundary time (T), milliseconds.")
+HOST_DEVICE_SENT_BYTES = REGISTRY.histogram(
+    "host_device_sent_bytes", BYTES_BUCKETS,
+    "Host->device bytes per engine dispatch (tokens + scalars).")
+HOST_DEVICE_RECV_BYTES = REGISTRY.histogram(
+    "host_device_recv_bytes", BYTES_BUCKETS,
+    "Device->host bytes per engine fetch (logits or token ids).")

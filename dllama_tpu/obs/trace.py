@@ -1,0 +1,126 @@
+"""Always-on in-process spans in a bounded ring buffer.
+
+The cheap first line of latency attribution: every request records a
+handful of spans (request → queue_wait → prefill → decode_chunk → emit →
+snapshot) into a fixed-capacity deque — no flags, no files, roughly one
+``perf_counter`` pair and a dict per span — and ``GET /debug/trace`` (or
+``tools/trace_dump.py``) dumps the recent ones as Chrome ``trace_event``
+JSON for ``chrome://tracing`` / Perfetto.  When a span points at a phase
+worth dissecting, ``--profile-split`` (runtime/profiling.py) remains the
+heavyweight XLA-level tool.
+
+Timestamps are ``time.perf_counter()`` seconds (converted to µs in the
+export); they order and measure correctly within one process but are not
+wall-clock.  Capacity comes from ``DLLAMA_TRACE_CAPACITY`` (default
+8192 spans ≈ a few hundred requests).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+from .log import request_id_var
+
+DEFAULT_CAPACITY = 8192
+
+
+def _capacity() -> int:
+    try:
+        return max(1, int(os.environ.get("DLLAMA_TRACE_CAPACITY", "")))
+    except ValueError:
+        return DEFAULT_CAPACITY
+
+
+class Tracer:
+    """Lock + ring buffer of completed spans (dicts)."""
+
+    def __init__(self, capacity: int | None = None):
+        self._lock = threading.Lock()
+        self._spans = deque(maxlen=capacity or _capacity())
+
+    def record(self, name: str, t0: float, t1: float, **args) -> None:
+        """Record a completed span; ``t0``/``t1`` are perf_counter secs."""
+        th = threading.current_thread()
+        span = {"name": name, "ts": t0, "dur": max(t1 - t0, 0.0),
+                "tid": th.ident or 0, "thread": th.name,
+                "rid": request_id_var.get(), "args": args}
+        with self._lock:
+            self._spans.append(span)
+
+    @contextmanager
+    def span(self, name: str, **args):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, t0, time.perf_counter(), **args)
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def trace_events(self, last_requests: int | None = None) -> list[dict]:
+        """Chrome ``trace_event`` array; optionally only the spans of the
+        last N distinct request IDs (id-less spans always kept)."""
+        spans = self.snapshot()
+        if last_requests is not None:
+            keep, order = set(), 0
+            for s in reversed(spans):
+                rid = s["rid"]
+                if rid is not None and rid not in keep:
+                    if order >= last_requests:
+                        continue
+                    keep.add(rid)
+                    order += 1
+            spans = [s for s in spans if s["rid"] is None or s["rid"] in keep]
+
+        tids, names = {}, {}
+        for s in spans:
+            if s["tid"] not in tids:
+                tids[s["tid"]] = len(tids) + 1
+                names[s["tid"]] = s["thread"]
+
+        events = [{"name": "thread_name", "ph": "M", "pid": 1, "tid": t,
+                   "args": {"name": f"{names[raw]} ({raw})"}}
+                  for raw, t in tids.items()]
+        for s in spans:
+            args = dict(s["args"])
+            if s["rid"]:
+                args["request_id"] = s["rid"]
+            events.append({"name": s["name"], "cat": "dllama", "ph": "X",
+                           "ts": round(s["ts"] * 1e6, 3),
+                           "dur": round(s["dur"] * 1e6, 3),
+                           "pid": 1, "tid": tids[s["tid"]], "args": args})
+        return events
+
+    def trace_json(self, last_requests: int | None = None) -> dict:
+        return {"traceEvents": self.trace_events(last_requests),
+                "displayTimeUnit": "ms"}
+
+
+#: THE process-global tracer.
+TRACER = Tracer()
+
+
+def record(name: str, t0: float, t1: float, **args) -> None:
+    TRACER.record(name, t0, t1, **args)
+
+
+def span(name: str, **args):
+    return TRACER.span(name, **args)
+
+
+def trace_json(last_requests: int | None = None) -> dict:
+    return TRACER.trace_json(last_requests)
+
+
+def clear() -> None:
+    TRACER.clear()

@@ -33,9 +33,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..models.config import ModelConfig
 from ..models.params import Params
 from ..models.transformer import forward_last, init_kv_cache
+from ..obs import metrics as obs_metrics, trace as obs_trace
+from ..obs.log import get_logger
 from ..parallel import sharding
 from ..parallel.mesh import active_mesh, make_mesh
 from ..sampling import Sampler
+
+_log = get_logger("runtime.engine")
 
 
 def _next_bucket(n: int, minimum: int = 16) -> int:
@@ -129,30 +133,45 @@ class StepStats:
 
 @dataclass
 class RunStats:
+    # Running sums keep every avg_* property O(1); the per-token list is
+    # retained for callers that want the full series (benchmarks, tests).
     tokens: list[StepStats] = field(default_factory=list)
+    _g_sum: float = field(default=0.0, repr=False)
+    _i_sum: float = field(default=0.0, repr=False)
+    _t_sum: float = field(default=0.0, repr=False)
+    _s_sum: float = field(default=0.0, repr=False)
+    _r_sum: float = field(default=0.0, repr=False)
 
     def add(self, s: StepStats):
         self.tokens.append(s)
+        self._g_sum += s.generation_ms
+        self._i_sum += s.inference_ms
+        self._t_sum += s.transfer_ms
+        self._s_sum += s.sent_bytes
+        self._r_sum += s.recv_bytes
+
+    def _avg(self, total: float) -> float:
+        return total / len(self.tokens) if self.tokens else 0.0
 
     @property
     def avg_generation_ms(self):
-        return float(np.mean([t.generation_ms for t in self.tokens])) if self.tokens else 0.0
+        return self._avg(self._g_sum)
 
     @property
     def avg_inference_ms(self):
-        return float(np.mean([t.inference_ms for t in self.tokens])) if self.tokens else 0.0
+        return self._avg(self._i_sum)
 
     @property
     def avg_transfer_ms(self):
-        return float(np.mean([t.transfer_ms for t in self.tokens])) if self.tokens else 0.0
+        return self._avg(self._t_sum)
 
     @property
     def avg_sent_bytes(self):
-        return float(np.mean([t.sent_bytes for t in self.tokens])) if self.tokens else 0.0
+        return self._avg(self._s_sum)
 
     @property
     def avg_recv_bytes(self):
-        return float(np.mean([t.recv_bytes for t in self.tokens])) if self.tokens else 0.0
+        return self._avg(self._r_sum)
 
     @property
     def tokens_per_second(self):
@@ -507,6 +526,14 @@ class Engine:
         stats.generation_ms = (t2 - t0) * 1000
         stats.sent_bytes = tokens_np.nbytes + 8  # token ids + pos/last scalars
         stats.recv_bytes = host_logits.nbytes
+        phase = "prefill" if tokens_np.shape[1] > 1 else "decode_step"
+        obs_trace.record(phase, t0, t2, pos=self.pos,
+                         n_tokens=int(tokens_np.shape[1]))
+        obs_metrics.ENGINE_GENERATION_MS.observe(stats.generation_ms)
+        obs_metrics.ENGINE_INFERENCE_MS.observe(stats.inference_ms)
+        obs_metrics.ENGINE_TRANSFER_MS.observe(stats.transfer_ms)
+        obs_metrics.HOST_DEVICE_SENT_BYTES.observe(stats.sent_bytes)
+        obs_metrics.HOST_DEVICE_RECV_BYTES.observe(stats.recv_bytes)
         return host_logits, stats
 
     def prefill(self, prompt_tokens: list[int]) -> tuple[np.ndarray, StepStats]:
@@ -525,6 +552,9 @@ class Engine:
         toks[:, :n] = prompt_tokens
         logits, stats = self._run(toks, n - 1)
         self.pos += n
+        _log.info("prefill", extra={
+            "n_tokens": n, "pos": self.pos,
+            "generation_ms": round(stats.generation_ms, 3)})
         return logits, stats
 
     def prefill_ragged(self, prompts: list[list[int]]
@@ -710,6 +740,15 @@ class Engine:
                     transfer_ms=t_ms,
                     sent_bytes=sent / k,
                     recv_bytes=toks.nbytes / k)
+                obs_trace.record("decode_chunk", g0, t2, pos=p0, k=k)
+                obs_metrics.ENGINE_GENERATION_MS.observe(per.generation_ms)
+                obs_metrics.ENGINE_INFERENCE_MS.observe(per.inference_ms)
+                obs_metrics.ENGINE_TRANSFER_MS.observe(per.transfer_ms)
+                obs_metrics.HOST_DEVICE_SENT_BYTES.observe(sent)
+                obs_metrics.HOST_DEVICE_RECV_BYTES.observe(toks.nbytes)
+                _log.debug("decode_chunk", extra={
+                    "pos": p0, "k": k,
+                    "generation_ms": round(per.generation_ms, 3)})
                 for j, tk in enumerate(toks.tolist()):
                     token = int(tk)
                     yield token, per
@@ -837,7 +876,10 @@ class Engine:
                 expected += k
                 pending = dispatch(last_dev, expected) \
                     if expected < steps and self.pos < self.seq_len else None
+                t0 = time.perf_counter()
                 self._sync(toks_dev, "batch decode chunk")
+                obs_trace.record("decode_chunk", t0, time.perf_counter(),
+                                 pos=self.pos - k, k=k, batch=True)
                 toks = np.asarray(toks_dev)  # (k, B)
                 for j in range(toks.shape[0]):
                     yield toks[j]

@@ -68,6 +68,10 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ..obs.log import get_logger
+
+_log = get_logger("runtime.faults")
+
 
 class FaultInjected(RuntimeError):
     """Default exception for a ``raise`` action with no exception name."""
@@ -218,6 +222,9 @@ class FaultRegistry:
                     due.append(f)
         actions = []
         for f in due:  # perform outside the lock: delay/raise must not block
+            _log.info("fault_fired", extra={
+                "point": f.point, "action": f.action, "arg": f.arg,
+                "fired": f.fired})
             a = f.perform()  # other points, and raise escapes here
             if a is not None:
                 actions.append(a)

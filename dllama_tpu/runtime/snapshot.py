@@ -42,6 +42,9 @@ import zlib
 import numpy as np
 
 from ..io.integrity import ArtifactError
+from ..obs.log import get_logger
+
+_log = get_logger("runtime.snapshot")
 
 MAGIC = b"DLSNAP01"
 _HEADER = struct.Struct("<8sII")  # magic, meta_len, crc32(meta || payload)
@@ -95,6 +98,10 @@ def save(path: str | os.PathLike, *, fingerprint: str, pos: int,
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    _log.debug("snapshot_saved", extra={
+        "path": path,
+        "bytes": _HEADER.size + len(meta) + sum(len(b) for b in blobs),
+        "pos": int(pos)})
     return path
 
 
@@ -177,6 +184,8 @@ def load(path: str | os.PathLike) -> tuple[dict, dict[str, np.ndarray]]:
                             "trailing bytes after last array",
                             offset=_HEADER.size + meta_len + off,
                             expected="EOF", got=f"{len(payload) - off} extra bytes")
+    _log.debug("snapshot_loaded", extra={
+        "path": path, "bytes": file_size, "pos": int(meta["pos"])})
     return meta, arrays
 
 

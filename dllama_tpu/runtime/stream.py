@@ -11,7 +11,10 @@ from __future__ import annotations
 
 from typing import Callable
 
+from ..obs.log import get_logger
 from ..tokenizer.eos import EOS, MAYBE_EOS, EosDetector
+
+_log = get_logger("runtime.stream")
 
 
 def drain_generation(engine, tokenizer, detector: EosDetector, stream,
@@ -71,4 +74,7 @@ def drain_generation(engine, tokenizer, detector: EosDetector, stream,
     # rewind and the natural end-of-stream accounting already land there;
     # this clamp brings the abandoned-mid-chunk (stop-string) case in line.
     engine.pos = min(engine.pos, prompt_end + max(n_completion - 1, 0))
+    _log.info("decode", extra={
+        "n_prompt": n_prompt, "n_completion": n_completion,
+        "ended_by_eos": ended_by_eos, "pos": engine.pos})
     return "".join(content), n_completion, ended_by_eos

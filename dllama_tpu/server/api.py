@@ -53,20 +53,32 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import re
 import signal
 import threading
 import time
 import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
-from ..io.integrity import ArtifactError, counters as integrity_counters
+from ..io.integrity import ArtifactError
+from ..obs import metrics as obs_metrics, trace as obs_trace
+from ..obs.log import (configure as configure_logging, get_logger,
+                       new_request_id, set_request_id)
 from ..runtime.engine import ContextOverflow, Engine, NumericFault, StepTimeout
 from ..runtime.faults import FAULTS
 from ..runtime.stream import drain_generation
 from ..tokenizer.bpe import Tokenizer
 from ..tokenizer.chat import ChatItem, ChatTemplate, TokenizerChatStops
 from ..tokenizer.eos import EosDetector
+
+_log = get_logger("server.api")
+
+#: client-supplied X-Request-Id is echoed but sanitized to this alphabet
+#: (it lands in logs and response headers verbatim otherwise)
+_RID_RE = re.compile(r"[^A-Za-z0-9._-]")
+_RID_MAX = 64
 
 #: request bodies above this are refused with 413 (an unbounded
 #: Content-Length read is an easy memory DoS against a model server)
@@ -164,45 +176,89 @@ def parse_request(body: dict, default_temp: float, default_topp: float) -> Infer
     return p
 
 
-@dataclass
-class ServerMetrics:
-    """Serving counters, aggregated like RunStats aggregates step stats —
-    one process-lifetime object, exported verbatim at ``/metrics``."""
-    started_at: float = field(default_factory=time.time)
-    requests_served: int = 0
-    requests_rejected_429: int = 0
-    requests_rejected_503: int = 0
-    read_timeouts_408: int = 0
-    deadline_timeouts: int = 0
-    client_disconnects: int = 0
-    server_errors: int = 0
-    avg_request_s: float = 0.0  # EMA; feeds the Retry-After hint
+#: serving counters this class mediates; each name is both the
+#: pre-registry ``/metrics`` JSON key and the obs registry json_key
+_SERVING_COUNTERS = (
+    "requests_served", "requests_rejected_429", "requests_rejected_503",
+    "read_timeouts_408", "deadline_timeouts", "client_disconnects",
+    "server_errors")
 
-    def __post_init__(self):
+
+class ServerMetrics:
+    """Per-``ApiState`` *view* over the process-global obs registry.
+
+    Bumps land in the one registry (so ``/metrics`` JSON and Prometheus
+    exposition read the same numbers), while attribute reads and
+    :meth:`snapshot` return deltas against a baseline captured at
+    construction — several ApiStates in one test process each see only
+    their own traffic, exactly like the pre-registry per-instance
+    dataclass.  ``avg_request_s`` stays a per-instance EMA (it feeds this
+    server's ``Retry-After`` hint); the global gauge mirrors it."""
+
+    def __init__(self):
+        self.started_at = time.time()
         self._lock = threading.Lock()
+        self._counters = {n: obs_metrics.REGISTRY.counter(n)
+                          for n in _SERVING_COUNTERS}
+        self._base = {n: c.value for n, c in self._counters.items()}
+        self._avg_request_s = 0.0  # EMA; feeds the Retry-After hint
 
     def bump(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            setattr(self, name, getattr(self, name) + n)
+        self._counters[name].inc(n)
 
     def observe_duration(self, seconds: float) -> None:
         with self._lock:
-            a = self.avg_request_s
-            self.avg_request_s = seconds if a == 0.0 else 0.8 * a + 0.2 * seconds
+            a = self._avg_request_s
+            self._avg_request_s = (seconds if a == 0.0
+                                   else 0.8 * a + 0.2 * seconds)
+        obs_metrics.AVG_REQUEST_S.set(self._avg_request_s)
+        obs_metrics.REQUEST_DURATION.observe(seconds)
+
+    @property
+    def avg_request_s(self) -> float:
+        with self._lock:
+            return self._avg_request_s
+
+    def __getattr__(self, name: str) -> int:
+        # counter reads (state.metrics.requests_served == 1 in tests) are
+        # deltas vs the construction baseline
+        try:
+            counters = object.__getattribute__(self, "_counters")
+            base = object.__getattribute__(self, "_base")
+        except AttributeError:
+            raise AttributeError(name) from None
+        if name in counters:
+            return counters[name].value - base[name]
+        raise AttributeError(name)
 
     def snapshot(self) -> dict:
-        with self._lock:
-            return {
-                "uptime_s": round(time.time() - self.started_at, 3),
-                "requests_served": self.requests_served,
-                "requests_rejected_429": self.requests_rejected_429,
-                "requests_rejected_503": self.requests_rejected_503,
-                "read_timeouts_408": self.read_timeouts_408,
-                "deadline_timeouts": self.deadline_timeouts,
-                "client_disconnects": self.client_disconnects,
-                "server_errors": self.server_errors,
-                "avg_request_s": round(self.avg_request_s, 6),
-            }
+        out = {"uptime_s": round(time.time() - self.started_at, 3)}
+        for n, c in self._counters.items():
+            out[n] = c.value - self._base[n]
+        out["avg_request_s"] = round(self.avg_request_s, 6)
+        return out
+
+
+class _StreamTimer:
+    """TTFT / inter-token latency observation for one request.
+
+    Constructed at admission (so engine-mutex queue wait counts into
+    TTFT, matching what the client experiences) and ticked after each
+    delta has been *flushed to the socket* — a slow emit path (e.g. an
+    injected ``server.emit_delta`` delay) therefore lands in the first
+    delta's TTFT bucket, not between buckets."""
+
+    def __init__(self):
+        self.t0 = time.monotonic()
+        self._last: float | None = None
+
+    def tick(self) -> None:
+        now = time.monotonic()
+        if self._last is None:
+            obs_metrics.TTFT.observe(now - self.t0)
+        else:
+            obs_metrics.INTER_TOKEN.observe(now - self._last)
+        self._last = now
 
 
 def _bounded(stream, state: "ApiState", deadline: float | None,
@@ -329,14 +385,18 @@ class ApiState:
             return None
         try:
             os.makedirs(self.snapshot_dir, exist_ok=True)
-            with self.engine_lock:
-                cache_items = [[it.end_pos, it.message.role, it.message.content]
-                               for it in self.naive_cache.items]
-                self.engine.snapshot(path, extra={"naive_cache": cache_items})
-            print(f"🔷 engine state snapshotted to {path}")
+            with obs_trace.span("snapshot_save", path=path):
+                with self.engine_lock:
+                    cache_items = [[it.end_pos, it.message.role,
+                                    it.message.content]
+                                   for it in self.naive_cache.items]
+                    self.engine.snapshot(path,
+                                         extra={"naive_cache": cache_items})
+            _log.info("snapshot_saved", extra={"path": path})
             return path
         except Exception as e:
-            print(f"⚠️  snapshot failed ({e}); state not persisted")
+            _log.warning("snapshot_save_failed", extra={
+                "path": path, "error": str(e)})
             return None
 
     def restore_snapshot(self) -> bool:
@@ -351,13 +411,16 @@ class ApiState:
         if path is None or not os.path.exists(path):
             return False
         try:
-            extra = self.engine.restore(path)
+            with obs_trace.span("snapshot_restore", path=path):
+                extra = self.engine.restore(path)
         except ArtifactError as e:
-            print(f"⚠️  snapshot rejected, cold start: {e}")
+            _log.warning("snapshot_rejected_cold_start", extra={
+                "path": path, "error": str(e)})
             self.engine.reset()
             return False
         except Exception as e:
-            print(f"⚠️  snapshot restore failed, cold start: {e}")
+            _log.warning("snapshot_restore_failed_cold_start", extra={
+                "path": path, "error": str(e)})
             self.engine.reset()
             return False
         for end_pos, role, content in extra.get("naive_cache", []):
@@ -367,9 +430,9 @@ class ApiState:
             os.remove(path)
         except OSError:
             pass
-        print(f"🔷 warm start: restored engine state from {path} "
-              f"(pos={self.engine.pos}, "
-              f"{len(self.naive_cache.items)} cached messages)")
+        _log.info("warm_start", extra={
+            "path": path, "pos": self.engine.pos,
+            "cached_messages": len(self.naive_cache.items)})
         return True
 
     def retry_after_hint(self) -> int:
@@ -902,22 +965,55 @@ def make_handler(state: ApiState):
         timeout = state.io_timeout if state.io_timeout > 0 else None
 
         def log_message(self, fmt, *a):
-            print(f"🔷 {self.command} {self.path}")
+            _log.debug("http", extra={"method": self.command,
+                                      "path": self.path})
 
         def send_response(self, *a, **kw):
             self._began_response = True
             super().send_response(*a, **kw)
+
+        def _begin_request(self) -> str:
+            """Assign the request ID at accept time: a client-supplied
+            ``X-Request-Id`` is echoed (sanitized — it lands in logs and
+            response headers verbatim) else one is generated.  Set into
+            the log contextvar so every record on this thread — server,
+            engine, faults, snapshot — carries it."""
+            client = self.headers.get("X-Request-Id") or ""
+            rid = _RID_RE.sub("", client)[:_RID_MAX] or new_request_id()
+            self._rid = rid
+            set_request_id(rid)
+            return rid
+
+        def _rid_header(self) -> None:
+            rid = getattr(self, "_rid", None)
+            if rid:
+                self.send_header("X-Request-Id", rid)
 
         def _json(self, code: int, obj: dict, headers: dict | None = None):
             data = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            self._rid_header()
             for k, v in (headers or {}).items():
                 self.send_header(k, str(v))
             if state.draining:
                 # drain wants connection threads gone promptly, not
                 # parked in keep-alive reads until the io timeout
+                self.close_connection = True
+            self.end_headers()
+            try:
+                self.wfile.write(data)
+            except OSError:
+                self.close_connection = True
+
+        def _text(self, code: int, text: str, content_type: str):
+            data = text.encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self._rid_header()
+            if state.draining:
                 self.close_connection = True
             self.end_headers()
             try:
@@ -984,7 +1080,8 @@ def make_handler(state: ApiState):
                 return None
             return body
 
-        def _completions(self, body: dict, deadline: float | None):
+        def _completions(self, body: dict, deadline: float | None,
+                         timer: _StreamTimer | None = None):
             """OpenAI text-completion endpoint; ``prompt`` may be a list
             and ``n`` replicates each prompt — every resulting row decodes
             as a distinct stream in one lockstep batch."""
@@ -1048,6 +1145,7 @@ def make_handler(state: ApiState):
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Connection", "close")
+                self._rid_header()
                 self.end_headers()
 
                 aborted = [False]
@@ -1059,6 +1157,7 @@ def make_handler(state: ApiState):
                     if aborted[0]:
                         return
                     try:
+                        e0 = time.perf_counter()
                         FAULTS.fire("server.emit_delta")
                         chunk = {"id": cid, "object": "text_completion",
                                  "created": created, "model": state.model_name,
@@ -1068,6 +1167,10 @@ def make_handler(state: ApiState):
                         self.wfile.write(
                             f"data: {json.dumps(chunk)}\n\n".encode())
                         self.wfile.flush()
+                        obs_trace.record("emit", e0, time.perf_counter(),
+                                         idx=idx)
+                        if timer is not None:
+                            timer.tick()
                         if finish == "timeout":
                             state.metrics.bump("deadline_timeouts")
                     except OSError:
@@ -1115,65 +1218,106 @@ def make_handler(state: ApiState):
                           "total_tokens": n_prompt + n_completion}})
 
         def do_GET(self):
-            if self.path == "/v1/models":
+            self._begin_request()
+            path, _, query = self.path.partition("?")
+            if path == "/v1/models":
                 self._json(200, {"object": "list", "data": [{
                     "id": state.model_name, "object": "model",
                     "created": int(time.time()), "owned_by": "user"}]})
-            elif self.path in ("/health", "/healthz"):
+            elif path in ("/health", "/healthz"):
                 # liveness probes keep getting a 200 during drain (the
                 # process IS alive); orchestrators read "status"/"ready"
                 # for the readiness decision
                 self._json(200, state.health())
-            elif self.path == "/metrics":
-                # serving counters + the process-global integrity counters
-                # (checksum_failures, numeric_faults, snapshot_restores —
-                # io/integrity.py): one scrape endpoint for both layers
-                self._json(200, {**state.metrics.snapshot(),
-                                 **integrity_counters()})
+            elif path == "/metrics":
+                # one registry, two formats (obs/metrics.py): Prometheus
+                # text 0.0.4 under Accept/?format negotiation, else the
+                # backward-compatible JSON dict — registry globals
+                # (integrity counters, histograms, schema_version) with
+                # this server's per-instance serving counters on top
+                q = parse_qs(query)
+                accept = self.headers.get("Accept") or ""
+                if (q.get("format", [""])[0] == "prometheus"
+                        or "text/plain" in accept or "openmetrics" in accept):
+                    self._text(200, obs_metrics.render_prometheus(),
+                               "text/plain; version=0.0.4; charset=utf-8")
+                else:
+                    merged = obs_metrics.snapshot_json()
+                    merged.update(state.metrics.snapshot())
+                    self._json(200, merged)
+            elif path == "/debug/trace":
+                # Chrome trace_event JSON for the last N requests' spans
+                # (obs/trace.py ring buffer; tools/trace_dump.py wraps this)
+                try:
+                    last = int(q[0]) if (q := parse_qs(query).get("last")) \
+                        else 20
+                except ValueError:
+                    last = 20
+                self._json(200, obs_trace.trace_json(last))
             else:
                 self._json(404, {"error": "not found"})
 
         def do_POST(self):
+            self._begin_request()
             if self.path not in ("/v1/chat/completions", "/v1/completions"):
                 self._json(404, {"error": "not found"})
                 return
+            _log.info("accept", extra={"path": self.path})
             body = self._read_body()
             if body is None:
                 return
             verdict = state.try_enter()
             if verdict == "draining":
                 state.metrics.bump("requests_rejected_503")
+                _log.info("reject", extra={"status": 503,
+                                           "reason": "draining"})
                 self._json(503, {"error": "server is draining; "
                                           "no new requests accepted"},
                            headers={"Retry-After": 30})
                 return
             if verdict == "full":
                 state.metrics.bump("requests_rejected_429")
+                _log.info("reject", extra={"status": 429, "reason": "full"})
                 self._json(429, {"error": f"server at capacity "
                                           f"({state.max_pending} requests "
                                           "pending); retry later"},
                            headers={"Retry-After": state.retry_after_hint()})
                 return
             t0 = time.monotonic()
+            tp0 = time.perf_counter()
             deadline = state.request_deadline(body)
+            # stream timer starts at admission: queue wait counts into TTFT
+            timer = _StreamTimer()
             try:
                 # THE engine mutex: one generation at a time per KV cache;
                 # the wait here IS the admission queue try_enter bounded
-                with state.engine_lock:
+                q0 = time.perf_counter()
+                state.engine_lock.acquire()
+                q1 = time.perf_counter()
+                obs_metrics.QUEUE_WAIT.observe(q1 - q0)
+                obs_trace.record("queue_wait", q0, q1)
+                _log.info("queue", extra={"wait_s": round(q1 - q0, 6)})
+                try:
                     state.mark_active(True)
                     try:
                         if self.path == "/v1/completions":
-                            self._completions(body, deadline)
+                            self._completions(body, deadline, timer)
                         else:
-                            self._chat(body, deadline)
+                            self._chat(body, deadline, timer)
                     finally:
                         state.mark_active(False)
+                finally:
+                    state.engine_lock.release()
                 state.metrics.bump("requests_served")
+                _log.info("finish", extra={
+                    "path": self.path,
+                    "duration_s": round(time.monotonic() - t0, 6)})
             except (BrokenPipeError, ConnectionResetError):
                 # client gone between chunks with nothing left to send;
                 # generation already stopped via the abort flag
                 state.metrics.bump("client_disconnects")
                 self.close_connection = True
+                _log.info("client_disconnect", extra={"path": self.path})
             except NumericFault as e:
                 # NaN/Inf logits (--numeric-checks): the KV cache may be
                 # poisoned from the step that diverged, so resume is NOT
@@ -1185,15 +1329,24 @@ def make_handler(state: ApiState):
                 state.naive_cache.clear()
                 state.engine.reset()
                 self._maybe_500(e)
+                _log.error("error", extra={"path": self.path,
+                                           "kind": "NumericFault",
+                                           "error": str(e)})
                 raise  # surface in the server log — corruption is a page
             except Exception as e:
                 state.metrics.bump("server_errors")
                 self._maybe_500(e)
+                _log.error("error", extra={"path": self.path,
+                                           "kind": type(e).__name__,
+                                           "error": str(e)})
                 raise  # surface in the server log — a 500 is a bug to fix
             finally:
                 state.leave(time.monotonic() - t0)
+                obs_trace.record("request", tp0, time.perf_counter(),
+                                 path=self.path)
 
-        def _chat(self, body: dict, deadline: float | None):
+        def _chat(self, body: dict, deadline: float | None,
+                  timer: _StreamTimer | None = None):
             try:
                 params = parse_request(body, state.default_temperature,
                                        state.default_topp)
@@ -1240,6 +1393,7 @@ def make_handler(state: ApiState):
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Connection", "close")
+                self._rid_header()
                 self.end_headers()
 
                 aborted = [False]
@@ -1251,6 +1405,7 @@ def make_handler(state: ApiState):
                     if aborted[0]:
                         return
                     try:
+                        e0 = time.perf_counter()
                         FAULTS.fire("server.emit_delta")
                         chunk = {"id": cid, "object": "chat.completion.chunk",
                                  "created": created, "model": state.model_name,
@@ -1260,6 +1415,9 @@ def make_handler(state: ApiState):
                         self.wfile.write(
                             f"data: {json.dumps(chunk)}\n\n".encode())
                         self.wfile.flush()
+                        obs_trace.record("emit", e0, time.perf_counter())
+                        if timer is not None:
+                            timer.tick()
                     except OSError:
                         aborted[0] = True
                         state.metrics.bump("client_disconnects")
@@ -1291,9 +1449,11 @@ def make_handler(state: ApiState):
                 self._safe_write(f"data: {json.dumps(final)}\n\n".encode()
                                  + b"data: [DONE]\n\n", aborted)
             else:
+                on_delta = (lambda d: timer.tick()) if timer is not None \
+                    else (lambda d: None)
                 try:
                     reply, n_prompt, n_completion, finish = state.complete(
-                        params, lambda d: None, deadline=deadline)
+                        params, on_delta, deadline=deadline)
                 except ContextOverflow as e:
                     self._json(400, {"error": str(e)})
                     return
@@ -1347,12 +1507,13 @@ def serve(state: ApiState, host: str = "0.0.0.0", port: int = 9990, *,
             if state.draining:  # second signal: operator means NOW
                 os._exit(1)
             state.begin_drain()
-            print(f"🔷 {signal.Signals(signum).name}: draining "
-                  f"(grace {state.drain_grace:.0f}s)")
+            _log.info("draining", extra={
+                "signal": signal.Signals(signum).name,
+                "grace_s": round(state.drain_grace, 1)})
             threading.Thread(target=server.shutdown, daemon=True).start()
         signal.signal(signal.SIGTERM, _drain)
         signal.signal(signal.SIGINT, _drain)
-    print(f"🔷 dllama-api listening on {host}:{port}")
+    _log.info("listening", extra={"host": host, "port": port})
     if block:
         try:
             server.serve_forever()
@@ -1363,7 +1524,7 @@ def serve(state: ApiState, host: str = "0.0.0.0", port: int = 9990, *,
         # warm start (--snapshot-dir; ApiState.restore_snapshot)
         if state.draining:
             state.save_snapshot()
-        print("🔷 drained; bye")
+        _log.info("drained")
     else:
         t = threading.Thread(target=server.serve_forever, daemon=True)
         t.start()
@@ -1377,6 +1538,7 @@ def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     # reuse the dllama flag surface; the server has no positional mode
     args = build_parser().parse_args(["inference", *argv])
+    configure_logging(args.log_format, args.log_level)
     if args.batch_slots > 0 and args.sp > 1:
         # the batch engine's ragged prefill needs the whole sequence axis
         # per shard (engine.prefill_ragged); accepting the flag would make
@@ -1394,7 +1556,8 @@ def main(argv=None):
                               batch=args.batch_slots, seq_len=args.max_seq_len,
                               kv_dtype=engine.cache.k.dtype,
                               step_timeout=args.step_timeout)
-        print(f"🔷 batched /v1/completions: {args.batch_slots} lockstep slots")
+        _log.info("batch_serving_enabled",
+                  extra={"slots": args.batch_slots})
     state = ApiState(engine, tok, default_temperature=args.temperature,
                      default_topp=args.topp, chunk=args.chunk,
                      batch_engine=batch_engine,

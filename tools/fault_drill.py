@@ -277,6 +277,47 @@ def drill_snapshot_restart(model, tok):
             s.stop()
 
 
+def drill_latency_histogram(model, tok):
+    """An injected 3s first-delta delay (server.emit_delta) must land the
+    request in the right TTFT bucket of the Prometheus exposition: the
+    fast buckets (le<=2.5) stay empty and the histogram sum reflects the
+    delay — the end-to-end check that the TTFT timer ticks AFTER the
+    emit-path flush, where a real latency fault would bite."""
+    import re
+    import urllib.request
+    # delay only the FIRST delta: TTFT eats the 3s, inter-token stays fast
+    s = Server(model, tok, faults="server.emit_delta=delay:3x1")
+    try:
+        s.wait_ready()
+        with post(s.base, dict(BODY, stream=True)) as r:
+            assert b"[DONE]" in r.read()
+            rid = r.headers.get("X-Request-Id")
+            assert rid, "stream response must carry X-Request-Id"
+        req = urllib.request.Request(s.base + "/metrics",
+                                     headers={"Accept": "text/plain"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert "version=0.0.4" in r.headers.get("Content-Type", "")
+            text = r.read().decode()
+
+        def sample(name):
+            m = re.search(rf"^{re.escape(name)} ([0-9.eE+-]+)$", text, re.M)
+            assert m, f"missing sample {name}:\n{text[:2000]}"
+            return float(m.group(1))
+
+        # buckets are cumulative: everything at or under 2.5s must be
+        # empty (the delayed delta cannot land in a fast bucket), and the
+        # observed sum carries the injected 3s
+        assert sample('dllama_ttft_seconds_bucket{le="2.5"}') == 0, text
+        assert sample("dllama_ttft_seconds_count") == 1, text
+        assert sample("dllama_ttft_seconds_sum") >= 3.0, text
+        # later deltas were NOT delayed: inter-token gaps were observed
+        # and none of them ate the 3s
+        assert sample("dllama_inter_token_seconds_count") >= 1, text
+        assert sample("dllama_inter_token_seconds_sum") < 3.0, text
+    finally:
+        s.stop()
+
+
 DRILLS = {
     "deadline": drill_deadline,
     "disconnect": drill_disconnect,
@@ -285,6 +326,7 @@ DRILLS = {
     "drain": drill_drain,
     "corruption": drill_corruption,
     "snapshot_restart": drill_snapshot_restart,
+    "latency_histogram": drill_latency_histogram,
 }
 
 

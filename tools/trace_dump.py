@@ -1,0 +1,72 @@
+#!/usr/bin/env python3
+"""Dump a running dllama-api server's span ring as a Chrome trace file.
+
+Fetches ``GET /debug/trace?last=N`` (dllama_tpu/obs/trace.py) and writes
+the Chrome ``trace_event`` JSON to a file loadable in ``chrome://tracing``
+or https://ui.perfetto.dev — the cheap first-line latency attribution for
+a live server (queue_wait / prefill / decode_chunk / emit / request spans
+per request ID), no restart and no ``--profile-split`` XLA tracer needed.
+
+Usage:
+    python tools/trace_dump.py http://127.0.0.1:9090 [-o trace.json] [-n 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from collections import Counter
+
+
+def fetch_trace(base: str, last: int, timeout: float = 10.0) -> dict:
+    url = f"{base.rstrip('/')}/debug/trace?last={last}"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def summarize(doc: dict) -> str:
+    """Per-span-name count + total ms, so the terminal shows where the
+    time went before anyone opens Perfetto."""
+    spans = [e for e in doc.get("traceEvents", []) if e.get("ph") == "X"]
+    counts = Counter(e["name"] for e in spans)
+    total_ms: Counter = Counter()
+    for e in spans:
+        total_ms[e["name"]] += e.get("dur", 0.0) / 1000.0
+    rids = {e["args"]["request_id"] for e in spans
+            if e.get("args", {}).get("request_id")}
+    lines = [f"{len(spans)} spans across {len(rids)} request(s):"]
+    for name, n in counts.most_common():
+        lines.append(f"  {name:<16} x{n:<5} {total_ms[name]:9.1f} ms total")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("base", help="server base URL, e.g. http://127.0.0.1:9090")
+    ap.add_argument("-o", "--out", default="trace.json",
+                    help="output file (default trace.json)")
+    ap.add_argument("-n", "--last", type=int, default=20,
+                    help="number of most-recent requests to include")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+
+    try:
+        doc = fetch_trace(args.base, args.last, args.timeout)
+    except Exception as e:
+        print(f"trace_dump: fetch failed: {e}", file=sys.stderr)
+        return 1
+    if not doc.get("traceEvents"):
+        print("trace_dump: no spans recorded yet (serve a request first)",
+              file=sys.stderr)
+    with open(args.out, "w") as f:
+        json.dump(doc, f)
+    print(f"wrote {args.out} — load it in chrome://tracing or "
+          f"https://ui.perfetto.dev")
+    print(summarize(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
