@@ -420,12 +420,27 @@ def _bench_decode(cfg, chunk=32, n_chunks=10, profile=False, start_pos=0,
     # feed the timed chunks into the obs step-latency histogram and log
     # the distribution (stderr) — same buckets the serving layer exports,
     # so a bench number and a /metrics scrape are directly comparable
-    from dllama_tpu.obs import metrics as obs_metrics
+    from dllama_tpu.obs import dispatch as obs_dispatch, \
+        metrics as obs_metrics
     for t in times:
         obs_metrics.ENGINE_GENERATION_MS.observe(t)
     h = obs_metrics.ENGINE_GENERATION_MS.json_value()
     print(f"bench: per-token ms distribution: count={h['count']} "
           f"avg={h['avg']:.3f} (dllama_engine_generation_ms)", file=sys.stderr)
+    # per-device HBM residency next to the timing number (the gauge readers
+    # are bound at runtime.engine import; {} on backends without allocator
+    # stats — absent, not zero)
+    from dllama_tpu.runtime import engine as _engine  # noqa: F401
+    hbm = obs_metrics.HBM_BYTES_IN_USE.values()
+    if hbm:
+        peak = obs_metrics.HBM_BYTES_PEAK.values()
+        print(f"bench: HBM in use "
+              f"{sum(hbm.values()) / 2**30:.2f} GiB over {len(hbm)} "
+              f"device(s), peak {sum(peak.values()) / 2**30:.2f} GiB "
+              f"(dllama_hbm_bytes_in_use)", file=sys.stderr)
+    # and the dispatch ledger: a decode number that fell off the fused
+    # Pallas path must say so next to the number it degrades
+    print(f"bench: {obs_dispatch.summary_line()}", file=sys.stderr)
     return float(np.mean(times))
 
 
@@ -1034,13 +1049,16 @@ def main():
         # freshness gate: a capture from THIS round only (the artifact is
         # committed, so a later dead-relay round must not replay it as
         # current evidence).  Primary check: the round stamp vs the
-        # driver's PROGRESS.jsonl (exact).  Fallback when either side
-        # lacks a round: captured_unix within 14 h (rounds run ~12 h and
-        # captures land mid-round; an unstamped artifact is stale — file
-        # mtime would reset to "now" on a fresh checkout).
+        # driver's PROGRESS.jsonl (exact).  A ROUND-STAMPED capture whose
+        # current round is unreadable is STALE — the stamp was written to
+        # be compared, and "can't read the round" must not widen into the
+        # time window (a replayed checkout always has a fresh mtime and
+        # often a recent clock).  The 14 h captured_unix window applies
+        # only to artifacts that never carried a round stamp.
         cur_round = current_round()
-        if cand.get("round") is not None and cur_round is not None:
-            fresh = int(cand["round"]) == cur_round
+        if cand.get("round") is not None:
+            fresh = (cur_round is not None
+                     and int(cand["round"]) == cur_round)
         else:
             fresh = (time.time() - float(cand.get("captured_unix") or 0)
                      < 14 * 3600)

@@ -133,6 +133,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="inference mode: after the run, trace a few decode steps "
                         "with the XLA profiler and report compute vs collective "
                         "time (the reference's I/T split, SURVEY §5-tracing)")
+    p.add_argument("--profile-ops", action="store_true",
+                   help="inference mode: like --profile-split but also lists "
+                        "the top per-op device times (where did the decode "
+                        "step's milliseconds actually go); same xplane trace, "
+                        "deeper report")
     p.add_argument("--nthreads", type=int, default=0, help="accepted for reference CLI parity; unused on TPU")
     p.add_argument("--port", type=int, default=9990,
                    help="accepted for reference CLI parity; only the API server "
@@ -285,6 +290,11 @@ def cmd_inference(args) -> None:
     print(f"Avg transfer time:   {stats.avg_transfer_ms:.2f} ms")
     print(f"Avg sent / recv:     {stats.avg_sent_bytes / 1024:.1f} kB / "
           f"{stats.avg_recv_bytes / 1024:.1f} kB")
+    # kernel-dispatch ledger (obs/dispatch.py): which matmul paths this run
+    # actually took, and loudly whether anything degraded — a benchmark
+    # number from an XLA-dequant fallback must not read as a clean result
+    from .obs import dispatch as obs_dispatch
+    print(obs_dispatch.summary_line())
     if engine.timing_mode == "host-fetch":
         # remote tunnel: the ready marker fires at dispatch, so I above is
         # the whole host-fetch wall (T≈0 by construction) — the xplane
@@ -300,8 +310,9 @@ def cmd_inference(args) -> None:
     import os as _os
     auto_prof = (engine.timing_mode == "host-fetch"
                  and _os.environ.get("DLLAMA_AUTO_PROFILE", "1") != "0")
-    if args.profile_split or auto_prof:
-        from .runtime.profiling import summarize_split, traced_op_times
+    if args.profile_split or args.profile_ops or auto_prof:
+        from .runtime.profiling import summarize_split, top_ops, \
+            traced_op_times
         if engine.pos + 4 > engine.seq_len:
             engine.reset()
             engine.prefill(ids)
@@ -317,8 +328,9 @@ def cmd_inference(args) -> None:
                   f"compute {sp['compute_ms']:.2f} ms, "
                   f"collectives {sp['collective_ms']:.2f} ms "
                   f"({sp['collective_pct']:.1f}%)")
-            for op, ms in sorted(times.items(), key=lambda kv: -kv[1])[:5]:
-                print(f"  top op {ms / n_steps:8.2f} ms  {op}")
+            n_top = 10 if args.profile_ops else 5
+            for op, ms in top_ops(times, n_top, n_steps):
+                print(f"  top op {ms:8.2f} ms  {op}")
 
 
 def cmd_generate(args) -> None:
@@ -388,6 +400,8 @@ def cmd_batch(args) -> None:
     print(f"Generated tokens:    {generated} over {len(prompts)} streams")
     if dt > 0:
         print(f"Batched throughput:  {generated / dt:.2f} tok/s")
+    from .obs import dispatch as obs_dispatch
+    print(obs_dispatch.summary_line())
 
 
 def cmd_chat(args) -> None:

@@ -14,6 +14,11 @@ Three stdlib-only building blocks, threaded through every layer:
   ring buffer, dumpable as Chrome ``trace_event`` JSON (``/debug/trace``
   + ``tools/trace_dump.py``); the cheap first-line latency attribution
   next to the heavyweight XLA tracer (``runtime/profiling.py``).
+* :mod:`.dispatch` — the kernel-dispatch ledger: which matmul path every
+  weight actually took (pallas-fused / pallas-blocked / xla-dequant /
+  dense), labeled degrade counters replacing the old warn-once prints,
+  and the process-wide ``degraded`` flag that ``/health`` and the
+  end-of-run CLI summary surface.
 
 Nothing here imports jax (or anything beyond the stdlib): the engine,
 loaders, and server all import ``obs`` freely with no cycle risk, and a
@@ -22,4 +27,4 @@ metric bump on the decode hot path costs one small lock.
 
 from __future__ import annotations
 
-from . import log, metrics, trace  # noqa: F401
+from . import dispatch, log, metrics, trace  # noqa: F401

@@ -131,6 +131,110 @@ class Gauge:
         lines.append(f"{self.name} {_fmt(self.value)}")
 
 
+class LabeledCounter:
+    """A counter family: one Prometheus metric name, one sample per label
+    value (``dllama_q40_degrade_total{reason="probe_failed"} 2``).  The
+    JSON exposition is a dict keyed by the label value (multi-label
+    children join their values with ``/``).  Children are created on
+    first increment — a scrape between registration and the first event
+    sees an empty family, which Prometheus accepts."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, json_key: str, labels, help: str = ""):
+        self.name = name
+        self.json_key = json_key
+        self.help = help
+        self.labels = (labels,) if isinstance(labels, str) else tuple(labels)
+        self._lock = threading.Lock()
+        self._children: dict[tuple, int] = {}
+
+    def inc(self, *values, n: int = 1) -> None:
+        if len(values) != len(self.labels):
+            raise ValueError(f"{self.name} takes {len(self.labels)} label "
+                             f"value(s) {self.labels}, got {values!r}")
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            self._children[key] = self._children.get(key, 0) + n
+
+    def get(self, *values) -> int:
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            return self._children.get(key, 0)
+
+    @property
+    def total(self) -> int:
+        with self._lock:
+            return sum(self._children.values())
+
+    def reset(self) -> None:
+        # test isolation parity with Counter.reset: drop the samples (a
+        # zeroed-but-present label would survive into unrelated tests)
+        with self._lock:
+            self._children.clear()
+
+    def json_value(self):
+        with self._lock:
+            return {"/".join(k): v for k, v in sorted(self._children.items())}
+
+    def render(self, lines: list[str]) -> None:
+        if self.help:
+            lines.append(f"# HELP {self.name} {_escape_help(self.help)}")
+        lines.append(f"# TYPE {self.name} counter")
+        with self._lock:
+            items = sorted(self._children.items())
+        for values, count in items:
+            lbl = ",".join(f'{l}="{v}"' for l, v in zip(self.labels, values))
+            lines.append(f"{self.name}{{{lbl}}} {count}")
+
+
+class LabeledGauge:
+    """A gauge family (one sample per label value).  ``fn`` — when set —
+    computes the whole family at read time as a ``{label_value: number}``
+    dict (e.g. per-device HBM stats queried at scrape); an empty dict
+    means the backend has no data and the family renders no samples
+    (graceful absence, never a fake zero)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, json_key: str, label: str, help: str = "",
+                 fn=None):
+        self.name = name
+        self.json_key = json_key
+        self.label = label
+        self.help = help
+        self.fn = fn
+        self._lock = threading.Lock()
+        self._values: dict[str, float] = {}
+
+    def set(self, label_value, v: float) -> None:
+        with self._lock:
+            self._values[str(label_value)] = float(v)
+
+    def values(self) -> dict[str, float]:
+        if self.fn is not None:
+            try:
+                return {str(k): float(v) for k, v in (self.fn() or {}).items()}
+            except Exception:
+                return {}
+        with self._lock:
+            return dict(self._values)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+    def json_value(self):
+        return {k: round(v, 6) for k, v in sorted(self.values().items())}
+
+    def render(self, lines: list[str]) -> None:
+        if self.help:
+            lines.append(f"# HELP {self.name} {_escape_help(self.help)}")
+        lines.append(f"# TYPE {self.name} gauge")
+        for k, v in sorted(self.values().items()):
+            lines.append(f'{self.name}{{{self.label}="{k}"}} {_fmt(v)}')
+
+
 class Histogram:
     """Fixed-bucket histogram (Prometheus semantics: cumulative buckets,
     an implicit ``+Inf`` bucket, ``sum`` and ``count`` series).
@@ -224,7 +328,7 @@ class Registry:
 
     def _register(self, cls, json_key: str, name: str | None, args, kwargs):
         name = name or ("dllama_" + json_key
-                        + ("_total" if cls is Counter else ""))
+                        + ("_total" if cls.kind == "counter" else ""))
         with self._lock:
             existing = self._by_json.get(json_key) or self._by_name.get(name)
             if existing is not None:
@@ -249,6 +353,20 @@ class Registry:
     def histogram(self, json_key: str, buckets, help: str = "",
                   name: str | None = None) -> Histogram:
         return self._register(Histogram, json_key, name, (buckets, help), {})
+
+    def labeled_counter(self, json_key: str, labels, help: str = "",
+                        name: str | None = None) -> LabeledCounter:
+        return self._register(LabeledCounter, json_key, name, (labels, help),
+                              {})
+
+    def labeled_gauge(self, json_key: str, label: str, help: str = "",
+                      name: str | None = None, fn=None) -> LabeledGauge:
+        g = self._register(LabeledGauge, json_key, name, (label, help), {})
+        if fn is not None:
+            # get-or-create may return an earlier registration; the newest
+            # reader wins (an Engine re-init re-binds the device query)
+            g.fn = fn
+        return g
 
     def metrics(self) -> list:
         with self._lock:
@@ -372,3 +490,44 @@ HOST_DEVICE_SENT_BYTES = REGISTRY.histogram(
 HOST_DEVICE_RECV_BYTES = REGISTRY.histogram(
     "host_device_recv_bytes", BYTES_BUCKETS,
     "Device->host bytes per engine fetch (logits or token ids).")
+
+# kernel-dispatch ledger (obs/dispatch.py; fed from ops/q40.py + ops/q8.py)
+MATMUL_DISPATCH = REGISTRY.labeled_counter(
+    "matmul_dispatch", ("codec", "path"),
+    "Matmul dispatch decisions by codec (q40/q8/dense) and executed path "
+    "(pallas-fused, pallas-blocked, xla-dequant, dense).  Counted at "
+    "trace time: one bump per compiled call site, not per decode step.")
+Q40_DEGRADE = REGISTRY.labeled_counter(
+    "q40_degrade", "reason",
+    "Q40 dispatches degraded off the fused Pallas path, by reason.")
+Q8_DEGRADE = REGISTRY.labeled_counter(
+    "q8_degrade", "reason",
+    "Q80 dispatches degraded off the fused Pallas path, by reason.")
+
+# compile telemetry (runtime/engine.py): bucketed-prefill recompiles vs
+# executable-cache hits, and how long each fresh compile stalled the host
+COMPILE_S_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                     30.0, 60.0, 120.0)
+ENGINE_RECOMPILES = REGISTRY.counter(
+    "engine_recompiles",
+    "XLA executables built by the engine (new step shape or chunk spec).")
+ENGINE_CACHE_HITS = REGISTRY.counter(
+    "engine_executable_cache_hits",
+    "Engine steps served by an already-compiled executable.")
+ENGINE_COMPILE_S = REGISTRY.histogram(
+    "engine_compile_seconds", COMPILE_S_BUCKETS,
+    "First-call wall time of each fresh engine executable (trace + XLA "
+    "compile dominate; includes the first execution's dispatch).")
+ENGINE_LIVE_EXECUTABLES = REGISTRY.gauge(
+    "engine_live_executables",
+    "Compiled executables the live engines currently hold.")
+
+# device-memory telemetry: per-device HBM gauges.  The reader fn is bound
+# by runtime/engine.py at import (jax stays out of the obs package);
+# backends without memory_stats (CPU) expose an empty family, not zeros.
+HBM_BYTES_IN_USE = REGISTRY.labeled_gauge(
+    "hbm_bytes_in_use", "device",
+    "Per-device HBM bytes currently allocated (jax memory_stats).")
+HBM_BYTES_PEAK = REGISTRY.labeled_gauge(
+    "hbm_bytes_peak", "device",
+    "Per-device peak HBM bytes allocated since process start.")

@@ -62,6 +62,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from .. import quants
+from ..obs import dispatch as obs_dispatch
 from ..parallel.mesh import get_active_mesh
 
 # Sweet spot measured on v5e (HBM-roofline for the 4096×11008 matvec);
@@ -720,8 +721,28 @@ class BlockedQTensor:
 # default blocked tiles: tn=512 keeps bn·td at 512 KB per DMA with td=2048
 # (well under the VMEM cap; wide td = the long sequential burst being
 # probed).  Overridable until a hardware sweep bakes a measured choice.
-BLOCKED_TILES = tuple(
-    int(v) for v in os.environ.get("DLLAMA_Q40_BLOCK_TILES", "512,2048").split(","))
+DEFAULT_BLOCKED_TILES = (512, 2048)
+
+
+def blocked_tiles_env() -> tuple[int, int]:
+    """The ``DLLAMA_Q40_BLOCK_TILES`` override, parsed LAZILY at each
+    :func:`to_blocked` call (an import-time parse would crash the process
+    on a typo and ignore post-import env changes).  The value must be
+    exactly two positive ints; anything else warns once through the
+    dispatch ledger and falls back to :data:`DEFAULT_BLOCKED_TILES`."""
+    spec = os.environ.get("DLLAMA_Q40_BLOCK_TILES", "")
+    if not spec:
+        return DEFAULT_BLOCKED_TILES
+    try:
+        parts = tuple(int(v) for v in spec.split(","))
+        if len(parts) != 2 or parts[0] <= 0 or parts[1] <= 0:
+            raise ValueError(spec)
+        return parts
+    except ValueError:
+        obs_dispatch.record_degrade(
+            "q40", "bad_block_tiles_env", warn_key=spec, spec=spec,
+            fallback=DEFAULT_BLOCKED_TILES)
+        return DEFAULT_BLOCKED_TILES
 
 
 def to_blocked(qt: QTensor, tn: int | None = None,
@@ -731,8 +752,9 @@ def to_blocked(qt: QTensor, tn: int | None = None,
     d pads up to a td multiple with ZERO scales, so pad output columns are
     exactly 0 and callers slice ``[..., :d]``.  One-time load-cost
     transform (device-side reshape/transpose)."""
-    tn = tn or BLOCKED_TILES[0]
-    td = td or BLOCKED_TILES[1]
+    env_tn, env_td = blocked_tiles_env()
+    tn = tn or env_tn
+    td = td or env_td
     lead_2d = qt.qpacked.ndim == 2
     qp0 = qt.qpacked[None] if lead_2d else qt.qpacked
     sc0 = qt.scales[None] if lead_2d else qt.scales
@@ -994,9 +1016,6 @@ def _sharded_matmul_ep(x2: jax.Array, qp4: jax.Array, s4: jax.Array,
                          out_specs=ospec, check_vma=False)(x2, qp4, s4, flat_idx)
 
 
-_FALLBACK_WARNED: set = set()
-
-
 @functools.cache
 def _pallas_ok(tile_n: int = 64, tile_d: int = 128, t: int = 1) -> bool:
     """Hardware probe: can Mosaic lower + run the fused kernel at this tile
@@ -1025,9 +1044,10 @@ def _pallas_ok(tile_n: int = 64, tile_d: int = 128, t: int = 1) -> bool:
             raise AssertionError("pallas probe result mismatch")
         return True
     except Exception as e:  # Mosaic lowering/runtime failure
-        print(f"⚠️  q40: fused pallas kernel unavailable for tile class "
-              f"(tile_n={tile_n}, tile_d={tile_d}, t={t}) "
-              f"({type(e).__name__}: {str(e)[:120]}); using the XLA dequant path")
+        obs_dispatch.record_degrade(
+            "q40", "probe_failed", warn_key=(tile_n, tile_d, t),
+            tile_n=tile_n, tile_d=tile_d, t=t,
+            error=f"{type(e).__name__}: {str(e)[:120]}")
         return False
 
 
@@ -1085,16 +1105,24 @@ def matmul(x: jax.Array, qt: QTensor | QLayerView, impl: str = "auto",
             impl = "pallas" if (on_tpu and rows <= PALLAS_MAX_ROWS
                                 and _dispatch_tiles_ok(np_probe, d, rows, kind)) else "xla"
 
-    if blocked and impl == "pallas" and not _blocked_tiles_ok(raw_qt):
-        # forced-pallas callers (cfg.quant_impl) get the same degrade as
-        # auto dispatch — never a Mosaic compile error mid-decode
-        key = ("blocked", raw_qt.tiles)
-        if key not in _FALLBACK_WARNED:
-            _FALLBACK_WARNED.add(key)
-            print(f"⚠️  q40: blocked tiles {raw_qt.tiles} are not hardware-"
-                  "legal (need tn ≥ 256, td % 128 == 0); using the XLA "
-                  "dequant path for this weight")
-        impl = "xla"
+    if blocked and impl == "pallas":
+        # forced-pallas callers (cfg.quant_impl) get the same degrades as
+        # auto dispatch — never a Mosaic compile error mid-forward
+        if not _blocked_tiles_ok(raw_qt):
+            obs_dispatch.record_degrade(
+                "q40", "blocked_tiles_illegal", warn_key=raw_qt.tiles,
+                tiles=raw_qt.tiles,
+                hint="need tn >= 256, td % 128 == 0, within the VMEM cap")
+            impl = "xla"
+        elif rows > PALLAS_MAX_ROWS:
+            # the blocked kernel's grid is sized for decode-width row
+            # counts; a forced-pallas prefill mirrors the auto-dispatch
+            # rows cap instead of hitting a lowering failure mid-forward
+            obs_dispatch.record_degrade(
+                "q40", "rows_exceed_pallas_max",
+                warn_key=("blocked", raw_qt.tiles), rows=rows,
+                max_rows=PALLAS_MAX_ROWS, tiles=raw_qt.tiles)
+            impl = "xla"
     if blocked and impl in ("pallas", "pallas_interpret"):
         if _smap_mesh() is not None:
             # blocked storage is single-device by construction (to_blocked
@@ -1105,6 +1133,8 @@ def matmul(x: jax.Array, qt: QTensor | QLayerView, impl: str = "auto",
         layer = qt.layer if isinstance(qt, QLayerView) else jnp.int32(0)
         np_ = raw_qt.qpacked.shape[1] * raw_qt.tiles[0]
         x2 = _pad_x(x.reshape(rows, n), n, np_)
+        obs_dispatch.record_dispatch("q40", "pallas-blocked", rows=rows,
+                                     tiles=raw_qt.tiles, layout="blocked")
         out = _pallas_matmul_blocked(x2, raw_qt.qpacked, raw_qt.scales,
                                      layer, interpret=impl == "pallas_interpret")
         return out[:, :d].reshape(*lead, d).astype(out_dtype)
@@ -1144,15 +1174,18 @@ def matmul(x: jax.Array, qt: QTensor | QLayerView, impl: str = "auto",
                                              layer, kind, mesh, interp)
                 else:
                     out = _sharded_matmul(x2, qp3, s3, layer, kind, mesh, interp)
+                obs_dispatch.record_dispatch(
+                    "q40", "pallas-fused", rows=rows, kind=kind,
+                    tp=mesh.shape.get("tp", 1), layout="row-major")
                 return out.reshape(*lead, d).astype(out_dtype)
-            key = (kind, np_, d, tp)
-            if key not in _FALLBACK_WARNED:
-                _FALLBACK_WARNED.add(key)
-                print(f"⚠️  q40: ({np_},{d}) kind={kind} not evenly shardable "
-                      f"over tp={tp}; using the XLA dequant path for this weight")
+            obs_dispatch.record_degrade(
+                "q40", "unshardable", warn_key=(kind, np_, d, tp),
+                shape=(np_, d), kind=kind, tp=tp)
             impl = "xla"
         else:
             x2 = _pad_x(x.reshape(rows, n), n, np_)
+            obs_dispatch.record_dispatch("q40", "pallas-fused", rows=rows,
+                                         kind=kind, layout="row-major")
             if layer is not None:
                 out = _pallas_matmul_stacked(x2, qp3, s3, layer, interpret=interp)
             else:
@@ -1161,6 +1194,8 @@ def matmul(x: jax.Array, qt: QTensor | QLayerView, impl: str = "auto",
     if impl == "xla":
         if isinstance(qt, QLayerView):
             qt = qt.sliced()
+        obs_dispatch.record_dispatch("q40", "xla-dequant", rows=rows,
+                                     kind=kind)
         w = dequantize(qt, dtype=jnp.bfloat16)
         return jnp.dot(x.astype(jnp.bfloat16), w,
                        preferred_element_type=jnp.float32).astype(out_dtype)
@@ -1179,5 +1214,7 @@ def mm(x: jax.Array, w, impl: str = "auto", out_dtype=None,
         if isinstance(base, (QTensor, BlockedQTensor)):
             return matmul(x, w, impl=impl, out_dtype=out_dtype, kind=kind)
         raise TypeError(f"mm: unsupported weight type {type(w).__name__}")
+    obs_dispatch.record_dispatch("dense", "dense",
+                                 rows=int(np.prod(x.shape[:-1]) or 1))
     out = x @ w
     return out.astype(out_dtype) if out_dtype is not None else out

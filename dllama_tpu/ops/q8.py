@@ -40,7 +40,6 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .. import quants
-from . import q40
 from .q40 import (PALLAS_MAX_ROWS, QLayerView, _f16_bits_to_f32, _pad_x,
                   _smap_mesh, _tiles, padded_n)
 
@@ -294,9 +293,11 @@ def _pallas_ok(tile_n: int, tile_d: int, t: int) -> bool:
             raise AssertionError("q8 pallas probe result mismatch")
         return True
     except Exception as e:
-        print(f"⚠️  q8: fused pallas kernel unavailable for tile class "
-              f"(tile_n={tile_n}, tile_d={tile_d}, t={t}) "
-              f"({type(e).__name__}: {str(e)[:120]}); using the XLA dequant path")
+        from ..obs import dispatch as obs_dispatch
+        obs_dispatch.record_degrade(
+            "q8", "probe_failed", warn_key=(tile_n, tile_d, t),
+            tile_n=tile_n, tile_d=tile_d, t=t,
+            error=f"{type(e).__name__}: {str(e)[:120]}")
         return False
 
 
@@ -330,8 +331,11 @@ def matmul(x: jax.Array, qt: Q8Tensor | QLayerView, impl: str = "auto",
                                            1 if rows == 1 else PALLAS_MAX_ROWS)) \
             else "xla"
 
+    from ..obs import dispatch as obs_dispatch
     if impl in ("pallas", "pallas_interpret") and _smap_mesh() is None:
         interp = impl == "pallas_interpret"
+        obs_dispatch.record_dispatch("q8", "pallas-fused", rows=rows,
+                                     layout="row-major")
         if is_view:
             qv3, s3 = qt.flat_planes()
             np_ = qv3.shape[-2]
@@ -347,13 +351,13 @@ def matmul(x: jax.Array, qt: Q8Tensor | QLayerView, impl: str = "auto",
         raise ValueError(f"unknown q8 matmul impl {impl!r} "
                          "(expected auto | xla | pallas | pallas_interpret)")
     if impl != "xla" and _smap_mesh() is not None:
-        key = ("q8-mesh", qt.logical_nd)
-        if key not in q40._FALLBACK_WARNED:
-            q40._FALLBACK_WARNED.add(key)
-            print(f"⚠️  q8: {qt.logical_nd} requested impl={impl!r} on a "
-                  "multi-device mesh; Q80 runs the GSPMD XLA path there "
-                  "(see module docstring)")
+        # Q80 has no shard_map kernel path: a forced-pallas request on a
+        # mesh degrades to the GSPMD XLA emulation (see module docstring)
+        obs_dispatch.record_degrade(
+            "q8", "mesh_xla", warn_key=qt.logical_nd,
+            shape=qt.logical_nd, impl=impl)
     # XLA path (meshes, CPU, probe failure)
+    obs_dispatch.record_dispatch("q8", "xla-dequant", rows=rows)
     base = qt.sliced() if is_view else qt
     w = dequantize(base, dtype=jnp.bfloat16)
     return jnp.dot(x.astype(jnp.bfloat16), w,

@@ -33,13 +33,39 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..models.config import ModelConfig
 from ..models.params import Params
 from ..models.transformer import forward_last, init_kv_cache
-from ..obs import metrics as obs_metrics, trace as obs_trace
+from ..obs import dispatch as obs_dispatch, metrics as obs_metrics, \
+    trace as obs_trace
 from ..obs.log import get_logger
 from ..parallel import sharding
 from ..parallel.mesh import active_mesh, make_mesh
 from ..sampling import Sampler
 
 _log = get_logger("runtime.engine")
+
+
+def _hbm_reader(stat: str):
+    """Bind a per-device memory_stats field to a labeled gauge: returns
+    ``{device_id: bytes}`` at read time, or ``{}`` where the backend has
+    no allocator stats (CPU, some emulators) — absence reads as no
+    samples, never as zeros."""
+    def read() -> dict:
+        out: dict[str, float] = {}
+        for d in jax.local_devices():
+            try:
+                ms = d.memory_stats()
+            except Exception:
+                ms = None
+            if ms and stat in ms:
+                out[str(d.id)] = float(ms[stat])
+        return out
+    return read
+
+
+# The obs package stays jax-free; the engine (which already owns the
+# devices) donates the reader at import.  LabeledGauge calls it lazily at
+# each /metrics read, so the gauges track live allocator state.
+obs_metrics.HBM_BYTES_IN_USE.fn = _hbm_reader("bytes_in_use")
+obs_metrics.HBM_BYTES_PEAK.fn = _hbm_reader("peak_bytes_in_use")
 
 
 def _next_bucket(n: int, minimum: int = 16) -> int:
@@ -254,10 +280,15 @@ class Engine:
                 from ..ops import q40
                 params = q40.blocked_params(params)
             else:
-                import sys
-                print("⚠️  DLLAMA_Q40_LAYOUT=blocked ignored: blocked "
-                      "storage is single-device only (mesh size "
-                      f"{self.mesh.size} keeps row-major)", file=sys.stderr)
+                # requested layout silently kept row-major — that is a
+                # degrade off the *requested* path, so it goes through the
+                # ledger (warn-once structured log + labeled counter +
+                # degraded flag), not scrollback
+                obs_dispatch.record_degrade(
+                    "q40", "blocked_ignored_mesh", warn_key=self.mesh.size,
+                    mesh_size=self.mesh.size,
+                    hint="blocked storage is single-device only; "
+                         "row-major keeps sharding semantics")
         self.params = sharding.place_params(params, cfg, self.mesh)
         # kv_dtype "q8" (or int8) selects the quantized cache: int8 values
         # + per-position f32 scales — ~2× less cache HBM traffic and
@@ -305,6 +336,11 @@ class Engine:
             self._step_ring = jax.jit(ring_step, donate_argnums=(1,),
                                       out_shardings=(self._rep, self._cache_sh))
         self._chunk_fns: dict = {}
+        # compile telemetry: step shapes that already built an executable
+        # (self._step/_step_ring jit-compile per (batch, T-bucket) shape;
+        # self._chunk_fns is its own executable cache) — lets _run tell a
+        # recompile from a cache hit without reaching into jax internals
+        self._compiled_steps: set = set()
         self._key = jax.random.PRNGKey(0)
         self._chunk_counter = 0
         self._offsets: jax.Array | None = None  # ragged-batch left padding
@@ -486,6 +522,24 @@ class Engine:
                      "bisect with --verify-weights and a dense kv cache")
         return host_logits
 
+    def _note_executable(self, fresh: bool, compile_s: float | None = None,
+                         key=None):
+        """Feed the compile-telemetry metrics for one executable lookup:
+        a recompile (with its first-call wall time, where the caller has a
+        clean boundary) or a cache hit, plus the live-executable gauge."""
+        if fresh:
+            obs_metrics.ENGINE_RECOMPILES.inc()
+            if compile_s is not None:
+                obs_metrics.ENGINE_COMPILE_S.observe(compile_s)
+            _log.info("compile", extra={
+                "key": repr(key),
+                "compile_s": None if compile_s is None
+                else round(compile_s, 3)})
+        else:
+            obs_metrics.ENGINE_CACHE_HITS.inc()
+        obs_metrics.ENGINE_LIVE_EXECUTABLES.set(
+            len(self._compiled_steps) + len(self._chunk_fns))
+
     def _run(self, tokens_np: np.ndarray, last_index: int,
              offsets: jax.Array | None = None) -> tuple[np.ndarray, StepStats]:
         stats = StepStats()
@@ -496,6 +550,12 @@ class Engine:
         # what lets a prompt longer than one chip's HBM prefill at all
         use_ring = (self.sp > 1 and self.pos == 0 and tokens_np.shape[1] > 1
                     and tokens_np.shape[1] % self.sp == 0)
+        # jit compiles per input shape: a shape first seen here is a fresh
+        # XLA executable, whose first-call wall (t1 - t0) is dominated by
+        # trace + compile — that's what the compile histogram records
+        step_key = ("ring" if use_ring else "step",
+                    tokens_np.shape, offsets is not None)
+        fresh_exec = step_key not in self._compiled_steps
         with active_mesh(self.mesh):  # read at trace time (first call)
             if use_ring:
                 toks = jax.device_put(
@@ -509,6 +569,10 @@ class Engine:
                     jnp.int32(self.pos), jnp.int32(last_index), offsets)
         fired = self._sync(logits, "prefill/decode step")
         t1 = time.perf_counter()
+        if fresh_exec:
+            self._compiled_steps.add(step_key)
+        self._note_executable(fresh_exec, (t1 - t0) if fresh_exec else None,
+                              key=step_key)
         host_logits = np.asarray(logits)  # (B, V)
         if "nan" in fired:  # injected device fault: poisoned logits
             host_logits = np.full_like(host_logits, np.nan)
@@ -617,7 +681,8 @@ class Engine:
         """Compiled on-device K-step generation loop (runtime/decode_loop.py)."""
         from .decode_loop import decode_chunk
         key = (steps, float(temperature), float(topp))
-        if key not in self._chunk_fns:
+        fresh = key not in self._chunk_fns
+        if fresh:
             cfg = self.cfg
             self._chunk_fns[key] = jax.jit(
                 lambda p, c, tok, pos, k, off=None: decode_chunk(
@@ -628,6 +693,10 @@ class Engine:
                 # keeps its sharding (see __init__)
                 out_shardings=(self._rep, self._cache_sh,
                                self._rep, self._rep, self._rep))
+        # compile seconds are observed at the first *call* (the dispatch
+        # sites), where jit actually traces + compiles; here only the
+        # recompile/cache-hit decision exists
+        self._note_executable(fresh, key=("chunk",) + key)
         return self._chunk_fns[key]
 
     def generate_stream(self, prompt_tokens: list[int], steps: int, *,
@@ -691,6 +760,7 @@ class Engine:
             # necessarily fetched) so a speculative chunk never overshoots
             # the requested steps
             k = min(chunk, steps - done, self.seq_len - self.pos)
+            fresh = (k, float(temperature), float(topp)) not in self._chunk_fns
             fn = self._chunk_fn(k, temperature, topp)
             sub = jax.random.fold_in(self._key, self._chunk_counter)
             self._chunk_counter += 1
@@ -706,6 +776,11 @@ class Engine:
                 toks_dev, self.cache, last_dev, _pos, _key = fn(
                     self.params, self.cache, jnp.asarray(in_tok_dev),
                     jnp.int32(p0), sub)
+            if fresh:
+                # jit's first call blocks through trace + XLA compile
+                # before the async dispatch returns — this wall is the
+                # compile cost the histogram tracks
+                obs_metrics.ENGINE_COMPILE_S.observe(time.perf_counter() - t0)
             self.pos = p0 + k
             return k, p0, toks_dev, last_dev, t0, sent
 
@@ -856,13 +931,17 @@ class Engine:
             # ``done`` = steps already covered by prior dispatches, so a
             # speculative chunk never runs past the consumer's budget
             k = min(chunk, steps - done, self.seq_len - self.pos)
+            fresh = (k, float(temperature), float(topp)) not in self._chunk_fns
             fn = self._chunk_fn(k, temperature, topp)
             sub = jax.random.fold_in(self._key, self._chunk_counter)
             self._chunk_counter += 1
+            tc = time.perf_counter()
             with active_mesh(self.mesh):
                 toks_dev, self.cache, last_dev, _pos, _key = fn(
                     self.params, self.cache, jnp.asarray(in_tok, jnp.int32),
                     jnp.int32(self.pos), sub, self._offsets)
+            if fresh:  # first call blocks through trace + compile
+                obs_metrics.ENGINE_COMPILE_S.observe(time.perf_counter() - tc)
             self.pos += k
             return k, toks_dev, last_dev
 
@@ -925,7 +1004,8 @@ class Engine:
             toks[r, bucket - len(s):] = s
             offsets[r] = bucket - len(s)
         key = ("score", bucket, top_k)
-        if key not in self._chunk_fns:
+        fresh_score = key not in self._chunk_fns
+        if fresh_score:
             cfg = self.cfg
 
             def score(p, c, tk, off):
@@ -946,12 +1026,16 @@ class Engine:
             # one replicated sharding as a pytree prefix covers however
             # many array outputs the top_k variant returns
             self._chunk_fns[key] = jax.jit(score, out_shardings=self._rep)
+        tc = time.perf_counter()
         with active_mesh(self.mesh):
             cache = init_kv_cache(self.cfg, self.batch, bucket,
                                   dtype=self.cache.k.dtype
                                   if not self.cache.quantized else None)
             tok_lp, ti, tl = self._chunk_fns[key](
                 self.params, cache, jnp.asarray(toks), jnp.asarray(offsets))
+        self._note_executable(
+            fresh_score,
+            (time.perf_counter() - tc) if fresh_score else None, key=key)
         return (np.asarray(tok_lp),
                 None if ti is None else np.asarray(ti),
                 None if tl is None else np.asarray(tl))
@@ -962,7 +1046,8 @@ class Engine:
         logits (B, T, V) — the speculative-decoding workhorse."""
         from ..models.transformer import forward
         key = ("verify", t)
-        if key not in self._chunk_fns:
+        fresh = key not in self._chunk_fns
+        if fresh:
             cfg = self.cfg
 
             def verify(p, c, toks, pos):
@@ -976,6 +1061,7 @@ class Engine:
             self._chunk_fns[key] = jax.jit(
                 verify, donate_argnums=(1,),
                 out_shardings=(self._rep, self._cache_sh))
+        self._note_executable(fresh, key=key)
         return self._chunk_fns[key]
 
     def generate_pld(self, prompt_tokens: list[int], steps: int, *,

@@ -152,6 +152,15 @@ def summarize_split(times: dict[str, float], steps: int = 1) -> dict:
     }
 
 
+def top_ops(times: dict[str, float], k: int = 10,
+            steps: int = 1) -> list[tuple[str, float]]:
+    """The top-``k`` ops by device time as ``(name, per-step ms)`` — the
+    one sort shared by the CLI's ``--profile-ops`` report and the
+    server's ``POST /debug/profile``."""
+    ranked = sorted(times.items(), key=lambda kv: -kv[1])[:k]
+    return [(op, ms / steps) for op, ms in ranked]
+
+
 def profiled_split(step: Callable[[], None], steps: int = 3) -> dict | None:
     """Trace ``steps`` calls of ``step()`` and attribute device-op time.
 

@@ -63,7 +63,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs
 
 from ..io.integrity import ArtifactError
-from ..obs import metrics as obs_metrics, trace as obs_trace
+from ..obs import dispatch as obs_dispatch, metrics as obs_metrics, \
+    trace as obs_trace
 from ..obs.log import (configure as configure_logging, get_logger,
                        new_request_id, set_request_id)
 from ..runtime.engine import ContextOverflow, Engine, NumericFault, StepTimeout
@@ -491,6 +492,12 @@ class ApiState:
             "max_pending": self.max_pending,
             "uptime_s": round(time.time() - self.metrics.started_at, 3),
             "requests_served": self.metrics.requests_served,
+            # kernel-dispatch ledger (obs/dispatch.py): a process that fell
+            # off its fast matmul path advertises it on every health probe —
+            # a degraded pod shows up in the fleet dashboard, not just in
+            # one scrollback warning at load time
+            "degraded": obs_dispatch.degraded(),
+            "degrade_reasons": obs_dispatch.reasons(),
         }
 
     # ------------------------------------------------------------------
@@ -1257,8 +1264,84 @@ def make_handler(state: ApiState):
             else:
                 self._json(404, {"error": "not found"})
 
+        def _debug_profile(self, query: str):
+            """``POST /debug/profile?steps=N&top=K`` — live per-op device
+            profile of the serving engine (docs/OBSERVABILITY.md).
+
+            Holds the engine mutex, traces N single-token decode steps
+            under the XLA profiler (runtime/profiling.traced_op_times) and
+            answers with the top-K ops by device time plus the
+            compute/collective split.  POST (not GET) because it perturbs
+            the serving engine: it borrows the mutex for ~N steps and
+            advances/rewinds the KV position.  Answers 503 while draining
+            and a clean 503 when the xplane proto tooling is absent."""
+            from ..runtime.profiling import summarize_split, top_ops, \
+                traced_op_times
+            if state.draining:
+                self._json(503, {"error": "server is draining"},
+                           headers={"Retry-After": 30})
+                return
+            q = parse_qs(query)
+
+            def qint(name, default, lo, hi):
+                try:
+                    v = int(q.get(name, [default])[0])
+                except ValueError:
+                    v = default
+                return max(lo, min(hi, v))
+
+            steps = qint("steps", 3, 1, 16)
+            top = qint("top", 10, 1, 50)
+            eng = state.engine
+            with state.engine_lock:
+                state.mark_active(True)
+                try:
+                    if eng.pos + steps + 1 > eng.seq_len:
+                        # no room to decode: drop the conversation state
+                        # (debug endpoint; same reset path as NumericFault)
+                        state.naive_cache.clear()
+                        eng.reset()
+                    pos0 = eng.pos
+                    try:
+                        # warm step OUTSIDE the trace so a fresh T=1
+                        # executable books compile time into the compile
+                        # histogram, not into the op profile
+                        eng.decode_one(1)
+                        times = traced_op_times(
+                            lambda: eng.decode_one(1), steps=steps)
+                    finally:
+                        # profiled steps are dead rows past the live
+                        # prefix — same overshoot invariant as an aborted
+                        # generation
+                        eng.pos = pos0
+                finally:
+                    state.mark_active(False)
+            if times is None:
+                self._json(503, {
+                    "error": "per-op profiling unavailable (xplane proto "
+                             "tooling missing or backend produced no "
+                             "trace)"})
+                return
+            split = summarize_split(times, steps)
+            ops = [{"op": op, "ms": round(ms, 4)}
+                   for op, ms in top_ops(times, top, steps)]
+            _log.info("profile", extra={"steps": steps,
+                                        "n_ops": len(times)})
+            self._json(200, {
+                "steps": steps,
+                "devices": eng.mesh.size,
+                "compute_ms": round(split["compute_ms"], 4),
+                "collective_ms": round(split["collective_ms"], 4),
+                "collective_pct": round(split["collective_pct"], 2),
+                "ops": ops,
+            })
+
         def do_POST(self):
             self._begin_request()
+            ppath, _, pquery = self.path.partition("?")
+            if ppath == "/debug/profile":
+                self._debug_profile(pquery)
+                return
             if self.path not in ("/v1/chat/completions", "/v1/completions"):
                 self._json(404, {"error": "not found"})
                 return

@@ -74,7 +74,12 @@ def cpu_env(n_devices: int = 1) -> dict:
 
 
 def run_cli(args: list[str], *, input_text: str | None = None, n_devices: int = 1,
-            timeout: int = 240) -> subprocess.CompletedProcess:
+            timeout: int = 240, env: dict | None = None) -> subprocess.CompletedProcess:
+    """``env`` overlays extra variables (e.g. DLLAMA_Q40_LAYOUT) on the
+    forced-CPU base environment."""
+    full_env = cpu_env(n_devices)
+    if env:
+        full_env.update(env)
     return subprocess.run(
-        [sys.executable, "-m", "dllama_tpu", *args], cwd=REPO, env=cpu_env(n_devices),
+        [sys.executable, "-m", "dllama_tpu", *args], cwd=REPO, env=full_env,
         input=input_text, capture_output=True, text=True, timeout=timeout)

@@ -1,0 +1,421 @@
+"""Kernel-dispatch ledger + compile/memory telemetry + live profiling
+(docs/OBSERVABILITY.md; obs/dispatch.py).
+
+The acceptance contract this file pins: NO silent degrade path remains —
+every Pallas/blocked/shard fallback in q40/q8 lands in a labeled registry
+counter and a structured log record, and an injected degrade is visible
+in ``/metrics`` (JSON and Prometheus), ``/health``, and the end-of-run
+CLI summary in the SAME test.  Plus: recompiles vs executable-cache hits
+are counted per engine step family, and ``POST /debug/profile`` answers
+a well-formed per-op report or a clean 503.
+"""
+
+import json
+import logging
+import os
+import re
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from fixtures import REPO, cpu_env, free_port, run_cli, write_tiny_model, \
+    write_tiny_tokenizer
+
+from dllama_tpu import quants
+from dllama_tpu.obs import dispatch as obs_dispatch, metrics as obs_metrics
+from dllama_tpu.ops import q40
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger():
+    """Each test sees a fresh ledger (the module state is process-global)."""
+    obs_dispatch.reset()
+    yield
+    obs_dispatch.reset()
+
+
+# --- unit: labeled registry types -----------------------------------------
+
+def test_labeled_counter_json_and_prometheus():
+    from dllama_tpu.obs.metrics import Registry
+    reg = Registry()
+    c = reg.labeled_counter("widget_events", ("kind", "path"), "help")
+    c.inc("a", "x")
+    c.inc("a", "x", n=2)
+    c.inc("b", "y")
+    assert c.name == "dllama_widget_events_total"
+    assert c.get("a", "x") == 3 and c.get("b", "y") == 1
+    assert c.get("never", "seen") == 0
+    assert c.total == 4
+    assert c.json_value() == {"a/x": 3, "b/y": 1}
+    lines = []
+    c.render(lines)
+    text = "\n".join(lines)
+    assert 'dllama_widget_events_total{kind="a",path="x"} 3' in text
+    assert 'dllama_widget_events_total{kind="b",path="y"} 1' in text
+    with pytest.raises(ValueError):
+        c.inc("only-one-label-value")
+    c.reset()
+    assert c.total == 0 and c.json_value() == {}
+
+
+def test_labeled_gauge_fn_and_graceful_absence():
+    from dllama_tpu.obs.metrics import Registry
+    reg = Registry()
+    g = reg.labeled_gauge("widget_bytes", "device",
+                          fn=lambda: {"0": 5.0, "1": 7.0})
+    def rendered():
+        lines = []
+        g.render(lines)
+        return "\n".join(lines)
+
+    assert g.values() == {"0": 5.0, "1": 7.0}
+    assert 'dllama_widget_bytes{device="0"} 5' in rendered()
+    # a reader that explodes reads as ABSENT (no samples), never as zeros
+    g.fn = lambda: 1 / 0
+    assert g.values() == {} and g.json_value() == {}
+    assert "widget_bytes{" not in rendered()
+
+
+# --- satellite: DLLAMA_Q40_BLOCK_TILES lazy validated parse ---------------
+
+def test_block_tiles_env_valid_and_default(monkeypatch):
+    monkeypatch.delenv("DLLAMA_Q40_BLOCK_TILES", raising=False)
+    assert q40.blocked_tiles_env() == q40.DEFAULT_BLOCKED_TILES
+    monkeypatch.setenv("DLLAMA_Q40_BLOCK_TILES", "256,1024")
+    assert q40.blocked_tiles_env() == (256, 1024)
+    assert obs_dispatch.degraded() is False
+
+
+@pytest.mark.parametrize("bad", ["banana", "512", "0,2048", "512,-1",
+                                 "512,2048,64"])
+def test_block_tiles_env_malformed_falls_back(monkeypatch, bad):
+    monkeypatch.setenv("DLLAMA_Q40_BLOCK_TILES", bad)
+    before = obs_metrics.Q40_DEGRADE.get("bad_block_tiles_env")
+    assert q40.blocked_tiles_env() == q40.DEFAULT_BLOCKED_TILES
+    assert obs_metrics.Q40_DEGRADE.get("bad_block_tiles_env") == before + 1
+    assert obs_dispatch.degraded() is True
+    assert "q40:bad_block_tiles_env" in obs_dispatch.reasons()
+
+
+def test_degrade_logs_once_but_counts_every_occurrence(monkeypatch):
+    records = []
+    h = logging.Handler()
+    h.emit = lambda r: records.append(r)
+    lg = logging.getLogger("dllama.obs.dispatch")
+    lg.addHandler(h)
+    old = lg.level
+    lg.setLevel(logging.DEBUG)
+    try:
+        monkeypatch.setenv("DLLAMA_Q40_BLOCK_TILES", "nope")
+        for _ in range(3):
+            q40.blocked_tiles_env()
+    finally:
+        lg.removeHandler(h)
+        lg.setLevel(old)
+    warned = [r for r in records if r.getMessage() == "kernel_degrade"]
+    assert len(warned) == 1, "warn-once per (codec, reason, warn_key)"
+    assert obs_metrics.Q40_DEGRADE.get("bad_block_tiles_env") == 3
+
+
+# --- tentpole: forced-pallas blocked guards (real degrades) ---------------
+
+def _blocked_fixture(n, d, seed=0):
+    rng = np.random.RandomState(seed)
+    qt = q40.quantize((rng.randn(n, d) * 0.05).astype(np.float32))
+    return qt, q40.to_blocked(qt)
+
+
+def test_forced_pallas_illegal_tiles_degrades_correctly():
+    """tn clamps below 256 on a tiny shape → Mosaic-illegal; forced pallas
+    must degrade through the ledger and still return the right numbers."""
+    import jax.numpy as jnp
+    qt, bqt = _blocked_fixture(128, 256)
+    assert bqt.tiles[0] < 256  # the premise: clamped-down, kernel-illegal
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 128), jnp.float32)
+    before = obs_metrics.Q40_DEGRADE.get("blocked_tiles_illegal")
+    out = q40.matmul(x, bqt, impl="pallas")
+    assert obs_metrics.Q40_DEGRADE.get("blocked_tiles_illegal") == before + 1
+    assert obs_dispatch.degraded() is True
+    ref = x.astype(jnp.bfloat16) @ q40.dequantize(qt, jnp.bfloat16)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_forced_pallas_blocked_rows_over_cap_degrades():
+    """Satellite: legal blocked tiles but rows > PALLAS_MAX_ROWS (a
+    forced-pallas prefill) must mirror the auto-dispatch rows cap instead
+    of a Mosaic lowering failure mid-forward."""
+    import jax.numpy as jnp
+    qt, bqt = _blocked_fixture(512, 256)
+    assert q40._blocked_tiles_ok(bqt)  # the premise: tiles are legal
+    rows = q40.PALLAS_MAX_ROWS + 1
+    x = jnp.asarray(np.random.RandomState(2).randn(rows, 512), jnp.float32)
+    before = obs_metrics.Q40_DEGRADE.get("rows_exceed_pallas_max")
+    out = q40.matmul(x, bqt, impl="pallas")
+    assert obs_metrics.Q40_DEGRADE.get("rows_exceed_pallas_max") == before + 1
+    assert out.shape == (rows, 256)
+    ref = x.astype(jnp.bfloat16) @ q40.dequantize(qt, jnp.bfloat16)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_dispatch_paths_recorded():
+    """Every resolved dispatch lands in the labeled matmul_dispatch family
+    (auto on CPU resolves to xla-dequant)."""
+    import jax.numpy as jnp
+    qt, _ = _blocked_fixture(128, 256)
+    x = jnp.ones((1, 128), jnp.float32)
+    before = obs_metrics.MATMUL_DISPATCH.get("q40", "xla-dequant")
+    q40.matmul(x, qt)  # impl="auto"; CPU → xla-dequant
+    assert obs_metrics.MATMUL_DISPATCH.get("q40", "xla-dequant") == before + 1
+    assert obs_dispatch.dispatches().get("q40/xla-dequant", 0) >= 1
+    assert obs_dispatch.degraded() is False  # a fallback by policy, not a degrade
+    assert "q40/xla-dequant" in obs_dispatch.summary_line()
+
+
+# --- tentpole: engine compile telemetry -----------------------------------
+
+@pytest.fixture(scope="module")
+def engine():
+    import jax
+    from dllama_tpu.models.config import tiny_config
+    from dllama_tpu.models.params import init_params
+    from dllama_tpu.parallel.mesh import make_mesh
+    from dllama_tpu.runtime.engine import Engine
+    cfg = tiny_config(seq_len=128, vocab_size=300)
+    return Engine(cfg, init_params(cfg, seed=4),
+                  mesh=make_mesh(tp=1, devices=jax.devices()[:1]))
+
+
+def test_recompile_vs_cache_hit_counting(engine):
+    """A fresh step shape is a recompile (observed into the compile-seconds
+    histogram); repeating it is a cache hit; the live-executable gauge
+    tracks what the engine holds."""
+    engine.reset()
+    rc0 = obs_metrics.ENGINE_RECOMPILES.value
+    ch0 = obs_metrics.ENGINE_CACHE_HITS.value
+    hist0 = obs_metrics.ENGINE_COMPILE_S.count
+    engine.prefill([1, 2, 3])          # bucket T=16 — may be warm from
+    rc1 = obs_metrics.ENGINE_RECOMPILES.value       # earlier module tests
+    engine.decode_one(5)               # T=1
+    rc2 = obs_metrics.ENGINE_RECOMPILES.value
+    ch2 = obs_metrics.ENGINE_CACHE_HITS.value
+    engine.decode_one(6)               # T=1 again → pure cache hit
+    assert obs_metrics.ENGINE_RECOMPILES.value == rc2
+    assert obs_metrics.ENGINE_CACHE_HITS.value == ch2 + 1
+    # every recompile observed a first-call wall into the histogram
+    assert (obs_metrics.ENGINE_COMPILE_S.count - hist0
+            == obs_metrics.ENGINE_RECOMPILES.value - rc0)
+    # the gauge equals what this engine holds (step shapes + chunk fns)
+    assert obs_metrics.ENGINE_LIVE_EXECUTABLES.value == \
+        len(engine._compiled_steps) + len(engine._chunk_fns)
+    assert obs_metrics.ENGINE_CACHE_HITS.value > ch0
+    assert rc1 >= rc0
+
+
+def test_chunk_fn_cache_hits(engine):
+    engine.reset()
+    rc0 = obs_metrics.ENGINE_RECOMPILES.value
+    list(engine.generate_stream([1, 2, 3], 8, chunk=4, seed=0))
+    rc1 = obs_metrics.ENGINE_RECOMPILES.value
+    ch1 = obs_metrics.ENGINE_CACHE_HITS.value
+    engine.reset()
+    list(engine.generate_stream([1, 2, 3], 8, chunk=4, seed=0))
+    # second identical run compiles nothing new and hits the caches
+    assert obs_metrics.ENGINE_RECOMPILES.value == rc1
+    assert obs_metrics.ENGINE_CACHE_HITS.value > ch1
+    assert rc1 > rc0  # the first run did build chunk executables
+
+
+# --- tentpole: HBM gauges --------------------------------------------------
+
+def test_hbm_gauges_graceful_on_cpu(engine):
+    """CPU backends expose no allocator stats: the gauges read as ABSENT
+    (empty family, no Prometheus samples), never as fabricated zeros."""
+    vals = obs_metrics.HBM_BYTES_IN_USE.values()
+    assert isinstance(vals, dict)
+    for v in vals.values():     # populated only where memory_stats exists
+        assert v >= 0
+    if not vals:
+        lines = []
+        obs_metrics.HBM_BYTES_IN_USE.render(lines)
+        assert not any("dllama_hbm_bytes_in_use{" in ln for ln in lines)
+
+
+# --- acceptance: one injected degrade, visible EVERYWHERE -----------------
+
+@pytest.fixture
+def api(engine, tmp_path):
+    from dllama_tpu.server.api import ApiState, serve
+    from dllama_tpu.tokenizer.bpe import Tokenizer
+    tok = Tokenizer(write_tiny_tokenizer(str(tmp_path / "tok.t")))
+    state = ApiState(engine, tok, default_temperature=0.0, chunk=2)
+    srv = serve(state, host="127.0.0.1", port=free_port(), block=False)
+    yield state, f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    srv.server_close()
+
+
+def _get(base, path, accept=None):
+    req = urllib.request.Request(base + path,
+                                 headers={"Accept": accept} if accept else {})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, r.read()
+
+
+def test_degrade_visible_in_metrics_health_and_summary(api):
+    """THE acceptance test: one real injected degrade (forced-pallas on
+    Mosaic-illegal blocked tiles) must show up in /metrics JSON, /metrics
+    Prometheus, /health, and the end-of-run CLI summary line — in this
+    one test."""
+    import jax.numpy as jnp
+    _, base = api
+    _, bqt = _blocked_fixture(128, 256)
+    q40.matmul(jnp.ones((1, 128), jnp.float32), bqt, impl="pallas")
+
+    code, raw = _get(base, "/metrics")
+    j = json.loads(raw)
+    assert code == 200
+    assert j["q40_degrade"].get("blocked_tiles_illegal", 0) >= 1
+    assert any(k.startswith("q40/") for k in j["matmul_dispatch"])
+
+    code, raw = _get(base, "/metrics?format=prometheus")
+    text = raw.decode()
+    m = re.search(r'dllama_q40_degrade_total\{reason="blocked_tiles_'
+                  r'illegal"\} (\d+)', text)
+    assert m and int(m.group(1)) >= 1
+    assert "# TYPE dllama_q40_degrade_total counter" in text
+    assert re.search(r'dllama_matmul_dispatch_total\{codec="q40",'
+                     r'path="[a-z-]+"\} \d+', text)
+
+    code, raw = _get(base, "/health")
+    h = json.loads(raw)
+    assert code == 200 and h["degraded"] is True
+    assert h["degrade_reasons"].get("q40:blocked_tiles_illegal", 0) >= 1
+
+    line = obs_dispatch.summary_line()   # what cmd_inference prints last
+    assert "DEGRADED" in line and "q40:blocked_tiles_illegal" in line
+
+
+def test_clean_run_reads_clean(api):
+    import jax.numpy as jnp
+    _, base = api
+    qt, _ = _blocked_fixture(128, 256)
+    q40.matmul(jnp.ones((1, 128), jnp.float32), qt)  # auto → xla, no degrade
+    _, raw = _get(base, "/health")
+    h = json.loads(raw)
+    assert h["degraded"] is False and h["degrade_reasons"] == {}
+    assert obs_dispatch.summary_line().startswith("💡 kernel dispatch: clean")
+
+
+# --- tentpole: POST /debug/profile ----------------------------------------
+
+def test_debug_profile_well_formed_or_clean_503(api):
+    _, base = api
+    req = urllib.request.Request(base + "/debug/profile?steps=2&top=4",
+                                 data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=240) as r:
+            code, body = r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        code, body = e.code, json.loads(e.read())
+    if code == 503:
+        assert "unavailable" in body["error"]
+        return
+    assert code == 200
+    assert body["steps"] == 2 and body["devices"] >= 1
+    assert body["compute_ms"] >= 0 and body["collective_ms"] >= 0
+    assert 0 <= body["collective_pct"] <= 100
+    assert 1 <= len(body["ops"]) <= 4
+    for op in body["ops"]:
+        assert op["op"] and op["ms"] >= 0
+    # ms sorted descending — the top-K contract
+    ms = [op["ms"] for op in body["ops"]]
+    assert ms == sorted(ms, reverse=True)
+
+
+def test_debug_profile_rejected_while_draining(api):
+    state, base = api
+    state.draining = True
+    try:
+        req = urllib.request.Request(base + "/debug/profile",
+                                     data=b"", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 503
+    finally:
+        state.draining = False
+
+
+def test_debug_profile_restores_engine_position(api):
+    state, base = api
+    eng = state.engine
+    eng.reset()
+    eng.prefill([1, 2, 3])
+    pos0 = eng.pos
+    req = urllib.request.Request(base + "/debug/profile?steps=1",
+                                 data=b"", method="POST")
+    try:
+        urllib.request.urlopen(req, timeout=240)
+    except urllib.error.HTTPError:
+        pass  # 503 without xplane tooling — position must STILL be intact
+    assert eng.pos == pos0
+
+
+# --- CLI: end-of-run summary (subprocess, real degrade) -------------------
+
+def test_cli_inference_prints_degraded_summary(tmp_path):
+    """`dllama inference` over a Q40 model with a malformed
+    DLLAMA_Q40_BLOCK_TILES must run to completion on the fallback tiles
+    AND say DEGRADED in its end-of-run dispatch summary."""
+    m = str(tmp_path / "m.m")
+    t = str(tmp_path / "m.t")
+    write_tiny_model(m, ftype=quants.Q40)
+    write_tiny_tokenizer(t)
+    r = run_cli(["inference", "--model", m, "--tokenizer", t,
+                 "--prompt", "hello", "--steps", "4", "--max-seq-len", "64"],
+                env={"DLLAMA_Q40_LAYOUT": "blocked",
+                     "DLLAMA_Q40_BLOCK_TILES": "banana"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "kernel dispatch: DEGRADED" in r.stdout
+    assert "q40:bad_block_tiles_env" in r.stdout
+
+
+@pytest.mark.slow
+def test_cli_inference_clean_summary(tmp_path):
+    m = str(tmp_path / "m.m")
+    t = str(tmp_path / "m.t")
+    write_tiny_model(m, ftype=quants.Q80)
+    write_tiny_tokenizer(t)
+    r = run_cli(["inference", "--model", m, "--tokenizer", t,
+                 "--prompt", "hello", "--steps", "4", "--max-seq-len", "64"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "kernel dispatch: clean" in r.stdout
+    assert "DEGRADED" not in r.stdout
+
+
+# --- satellite: fast tier keeps its non-trivial core ----------------------
+
+def test_fast_tier_collects_core_suites():
+    """Meta-test: `-m 'not slow'` must keep collecting a non-trivial core —
+    the codec tests, the N-shard≡1-shard parity tests, and this ledger
+    file.  Guards against a slow-marker sweep quietly emptying tier 1."""
+    targets = ["tests/test_quants.py", "tests/test_parallel.py",
+               "tests/test_dispatch_ledger.py"]
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "--collect-only", "-q",
+         "-m", "not slow", "-p", "no:cacheprovider", *targets],
+        cwd=REPO, env=cpu_env(), capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    for f in targets:
+        n = len(re.findall(re.escape(f) + r"::", r.stdout))
+        assert n >= 3, f"fast tier collects only {n} tests from {f}"
