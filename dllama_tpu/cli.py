@@ -145,7 +145,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-slots", type=int, default=0,
                    help="api server: serve /v1/completions list-prompts as one "
                         "lockstep batch with this many slots (a second KV "
-                        "cache; weights are shared)")
+                        "cache; weights are shared); also enables the "
+                        "continuous-batching slot scheduler for single-"
+                        "stream requests (runtime/scheduler.py)")
+    p.add_argument("--sched-prefill-chunk", type=int, default=16,
+                   help="continuous batching: prompt tokens fed per mixed "
+                        "prefill step when a request joins mid-decode; "
+                        "smaller chunks bound the extra inter-token latency "
+                        "a join adds to running streams")
+    p.add_argument("--sched-max-wait-ms", type=float, default=50.0,
+                   help="continuous batching: with requests queued for a "
+                        "slot, clamp on-device decode bursts so a finishing "
+                        "stream frees its slot within about this many "
+                        "milliseconds")
     # ---- serving robustness (api server; docs/ROBUSTNESS.md) ----
     p.add_argument("--host", default="0.0.0.0",
                    help="api server: bind address (default 0.0.0.0)")

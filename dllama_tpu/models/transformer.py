@@ -31,7 +31,8 @@ import jax.numpy as jnp
 
 from ..ops import q40, q8
 from ..ops.attention import (gqa_attention_at, quantize_kv,
-                             update_kv_cache_at)
+                             slot_gqa_attention_at, update_kv_cache_at,
+                             update_kv_cache_rows)
 from ..ops.kernels import ACTIVATIONS, apply_rope, rmsnorm, rope_angles, softmax_f32
 from ..ops.sp_attention import ring_attention, sp_gqa_attention, sp_update_kv_cache_at
 from ..parallel.mesh import get_active_mesh
@@ -113,7 +114,7 @@ def update_cache_at(cache: KVCache, k_new, v_new, layer, pos) -> KVCache:
 
 
 def _attention_block(x, lp, cfg: ModelConfig, cache: KVCache, cos, sin, pos,
-                     layer, offsets=None):
+                     layer, offsets=None, pos_rows=None):
     """One attention sub-block.  ``cache`` holds the *stacked*
     (L, B, Hkv, S, Dh) buffers carried through the layer scan; this layer
     writes its (B, Hkv, T, Dh) step window in place at ``(layer, pos)`` and
@@ -143,6 +144,15 @@ def _attention_block(x, lp, cfg: ModelConfig, cache: KVCache, cos, sin, pos,
     mesh = get_active_mesh()
     sp_on = mesh is not None and mesh.shape.get("sp", 1) > 1
     ring = sp_on and cfg.ring_prefill and t > 1
+    if pos_rows is not None:
+        # continuous-batching slots: per-row write positions and per-row
+        # causal ceilings (sp meshes and quantized caches are gated off
+        # the slot path at the engine boundary)
+        ck, cv = update_kv_cache_rows(cache.k, cache.v, k, v, layer, pos_rows)
+        cache = KVCache(ck, cv)
+        att = slot_gqa_attention_at(q, cache.k, cache.v, layer, pos_rows)
+        att = att.transpose(0, 2, 1, 3).reshape(b, t, hq * dh)
+        return _mm(att, lp["wo"], cfg, kind="col"), cache
     if t == 1 and sp_on:
         # seq-sharded cache: explicit shard-local write (no GSPMD-chosen
         # gather/scatter per decode step); quantized caches are gated off
@@ -292,7 +302,9 @@ def moe_ffn(xb2d: jax.Array, lp, cfg: ModelConfig) -> jax.Array:
 
 def run_blocks(params: Params, cfg: ModelConfig, tokens: jax.Array,
                cache: KVCache, pos: jax.Array,
-               offsets: jax.Array | None = None) -> tuple[jax.Array, KVCache]:
+               offsets: jax.Array | None = None,
+               pos_rows: jax.Array | None = None
+               ) -> tuple[jax.Array, KVCache]:
     """Embed + all transformer blocks; returns the residual stream (B, T, D)
     and the updated cache.
 
@@ -310,7 +322,12 @@ def run_blocks(params: Params, cfg: ModelConfig, tokens: jax.Array,
         x = x * jnp.asarray(cfg.embedding_scale, cfg.dtype)
 
     positions = pos + jnp.arange(t)
-    if offsets is not None:
+    if pos_rows is not None:
+        # continuous-batching slots: every row has its own clock, and slot
+        # requests always start at cache position 0, so cache position ==
+        # logical RoPE position (no offset subtraction)
+        positions = pos_rows[:, None] + jnp.arange(t)[None, :]
+    elif offsets is not None:
         # per-row logical positions; pad slots clamp to 0 (their k/q values
         # are garbage either way and masked out of every live row's view)
         positions = jnp.maximum(positions[None, :] - offsets[:, None], 0)
@@ -333,7 +350,8 @@ def run_blocks(params: Params, cfg: ModelConfig, tokens: jax.Array,
         for k in qt_keys:
             lp[k] = q40.QLayerView(params[k], idx)
         att_out, kvc = _attention_block(x, lp, cfg, kvc, cos, sin, pos,
-                                        idx, offsets=offsets)
+                                        idx, offsets=offsets,
+                                        pos_rows=pos_rows)
         if cfg.post_block_norms:
             att_out = rmsnorm(att_out, lp["rms_ffn"])  # grokRmfFfnNorm
         x = x + att_out
@@ -394,4 +412,32 @@ def forward_last(params: Params, cfg: ModelConfig, tokens: jax.Array,
     variant."""
     x, cache = run_blocks(params, cfg, tokens, cache, pos, offsets=offsets)
     x_last = jax.lax.dynamic_slice_in_dim(x, last_index, 1, axis=1)[:, 0]  # (B, D)
+    return _head(params, cfg, x_last), cache
+
+
+def forward_slots(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                  cache: KVCache, pos_rows: jax.Array, n_valid: jax.Array
+                  ) -> tuple[jax.Array, KVCache]:
+    """Continuous-batching slot step: run ``tokens`` (B, T) where row ``r``
+    occupies cache positions ``pos_rows[r]..pos_rows[r]+T-1`` and only its
+    first ``n_valid[r]`` tokens are real.  Returns the logits at each
+    row's last *valid* token (B, V) and the updated cache.
+
+    This is what lets a joining request prefill while its neighbors keep
+    decoding: a prefilling slot feeds a prompt chunk (``n_valid`` = chunk
+    length), a decoding slot feeds its previous sample plus padding
+    (``n_valid`` = 1), and a free slot rides along at position 0.  Rows
+    never see each other (attention masks per row, everything else is
+    row-local), so each slot's stream is bit-identical to decoding alone.
+    Garbage written above a row's ``n_valid`` window lands at positions
+    the row has not reached yet — masked by its causal ceiling until the
+    real tokens overwrite them (see ops.attention.slot_gqa_attention_at).
+    """
+    t = tokens.shape[1]
+    x, cache = run_blocks(params, cfg, tokens, cache, jnp.int32(0),
+                          pos_rows=pos_rows)
+    idx = jnp.clip(n_valid - 1, 0, t - 1)
+    x_last = jax.vmap(
+        lambda row, i: jax.lax.dynamic_index_in_dim(row, i, 0, keepdims=False)
+    )(x, idx)  # (B, D): per-row last-valid gather
     return _head(params, cfg, x_last), cache

@@ -522,6 +522,26 @@ ENGINE_LIVE_EXECUTABLES = REGISTRY.gauge(
     "engine_live_executables",
     "Compiled executables the live engines currently hold.")
 
+# continuous-batching scheduler (runtime/scheduler.py).  Efficiency is
+# set per dispatch: live rows / slots — pad/free rows ride the lockstep
+# step for free but represent unsold capacity, which is exactly what this
+# gauge makes visible.  The one-shot list-prompt path sets it too (its
+# pad rows are the same unsold capacity).
+SCHED_SLOTS_OCCUPIED = REGISTRY.gauge(
+    "sched_slots_occupied", "Batch slots holding a live request.")
+SCHED_QUEUE_DEPTH = REGISTRY.gauge(
+    "sched_queue_depth", "Requests admitted but waiting for a free slot.")
+SCHED_BATCH_EFFICIENCY = REGISTRY.gauge(
+    "sched_batch_efficiency",
+    "Live rows per lockstep step / batch slots (last dispatch).")
+SCHED_SLOT_JOINS = REGISTRY.labeled_counter(
+    "sched_slot_joins", ("slot",),
+    "Requests admitted into a batch slot, by slot index.")
+SCHED_SLOT_RETIRES = REGISTRY.labeled_counter(
+    "sched_slot_retires", ("slot", "reason"),
+    "Requests retired from a batch slot, by slot index and reason "
+    "(stop/length/timeout/aborted/error/drain).")
+
 # device-memory telemetry: per-device HBM gauges.  The reader fn is bound
 # by runtime/engine.py at import (jax stays out of the obs package);
 # backends without memory_stats (CPU) expose an empty family, not zeros.

@@ -67,6 +67,83 @@ def update_kv_cache_at(k_cache: jax.Array, v_cache: jax.Array,
     return k_cache, v_cache
 
 
+def update_kv_cache_rows(k_cache: jax.Array, v_cache: jax.Array,
+                         k_new: jax.Array, v_new: jax.Array,
+                         layer: jax.Array, pos_rows: jax.Array
+                         ) -> tuple[jax.Array, jax.Array]:
+    """Write one layer's step KV (B, Hkv, T, Dh) into the *stacked*
+    (L, B, Hkv, S, Dh) caches at **per-row** positions (B,).
+
+    The continuous-batching twin of :func:`update_kv_cache_at`: slot rows
+    belong to different requests, so each row advances its own clock —
+    a joining slot prefills at position 0 while its neighbors decode at
+    position 900.  A vmap over the batch axis gives every row its own
+    ``dynamic_update_slice`` start, which XLA lowers to B independent
+    windowed writes into the carried cache (same in-place cost model as
+    the shared-clock write).
+
+    Callers must keep ``pos_rows[r] + T <= S`` for every row:
+    dynamic_update_slice clamps out-of-range starts *backward*, which
+    would silently overwrite the newest valid history (the scheduler
+    retires rows at the context edge before dispatching)."""
+
+    def row(ck, cv, kn, vn, p):
+        # ck/cv: (L, Hkv, S, Dh) one row's stacked planes; kn/vn: (Hkv, T, Dh)
+        zero = jnp.zeros((), jnp.int32)
+        idx = (layer.astype(jnp.int32), zero, p.astype(jnp.int32), zero)
+        ck = jax.lax.dynamic_update_slice(ck, kn[None].astype(ck.dtype), idx)
+        cv = jax.lax.dynamic_update_slice(cv, vn[None].astype(cv.dtype), idx)
+        return ck, cv
+
+    return jax.vmap(row, in_axes=(1, 1, 0, 0, 0), out_axes=(1, 1))(
+        k_cache, v_cache, k_new, v_new, pos_rows)
+
+
+def slot_gqa_attention_at(q: jax.Array, ck: jax.Array, cv: jax.Array,
+                          layer: jax.Array, pos_rows: jax.Array) -> jax.Array:
+    """One-shot causal GQA over the *stacked* caches at ``layer`` with a
+    **per-row** causal ceiling: row ``r``'s query tokens occupy cache
+    positions ``pos_rows[r]..pos_rows[r]+T-1`` and may see key positions
+    ``<= pos_rows[r] + t_local`` only.
+
+    This is the attention read of the continuous-batching slot step.
+    Unlike the ragged-batch path there is no key *floor*: every slot's
+    request starts at cache position 0, and a freed slot is reused by
+    simply resetting its position — the previous occupant's stale keys
+    sit *above* the new request's ceiling, masked until each position is
+    overwritten by the new occupant (write-before-visible).  Zeroing the
+    row instead would be wrong twice over: it costs an O(S) write, and a
+    zero key is a *real* key (it would contribute exp(0-ish) mass to the
+    softmax denominator).
+
+    Per-step traffic is O(S) like the one-shot decode path; slot serving
+    targets the throughput regime (batch > 1, moderate context) where the
+    weight read — amortized over B rows — dominates.
+    """
+    b, hq, t, dh = q.shape
+    hkv = ck.shape[2]
+    s = ck.shape[3]
+    g = hq // hkv
+    k_l = jax.lax.dynamic_index_in_dim(ck, layer, 0, keepdims=False)
+    v_l = jax.lax.dynamic_index_in_dim(cv, layer, 0, keepdims=False)
+
+    # operands in cache dtype, f32 accumulation — see _online_fold for why
+    qc = q.reshape(b, hkv, g, t, dh).astype(k_l.dtype)
+    scores = jnp.einsum("bhgtd,bhsd->bhgts", qc, k_l,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(dh))
+
+    s_idx = jnp.arange(s)[None, None, :]
+    t_idx = pos_rows[:, None, None] + jnp.arange(t)[None, :, None]
+    mask = s_idx <= t_idx  # (B, T, S) — per-row causal ceiling
+    scores = jnp.where(mask[:, None, None], scores, _NEG)
+
+    probs = softmax_f32(scores, axis=-1)
+    out = jnp.einsum("bhgts,bhsd->bhgtd", probs.astype(v_l.dtype), v_l,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, hq, t, dh).astype(q.dtype)
+
+
 # Above this many score elements per kv-head group, prefill switches to the
 # blocked online-softmax path: the one-shot path materializes the full
 # (B, Hkv, G, T, S) f32 score tensor, which becomes the HBM wall at long

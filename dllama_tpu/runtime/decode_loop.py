@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.config import ModelConfig
-from ..models.transformer import KVCache, forward_last
+from ..models.transformer import KVCache, forward_last, forward_slots
 from ..ops.kernels import softmax_f32
 
 
@@ -75,3 +75,75 @@ def decode_chunk(params, cfg: ModelConfig, cache: KVCache, token: jax.Array,
     (cache, last, pos, key), toks = jax.lax.scan(
         body, (cache, token, pos, key), None, length=steps)
     return toks, cache, last, pos, key
+
+
+def device_sample_rows(logits: jax.Array, key: jax.Array, temps: jax.Array,
+                       topps: jax.Array, greedy: bool) -> jax.Array:
+    """Per-row-parameter sampling (B, V) → (B,) for continuous-batching
+    slots: rows belong to *different requests*, so temperature/top-p
+    arrive as (B,) traced arrays rather than static floats — one compiled
+    program serves any mix of per-request settings.  Rows with
+    temperature 0 take the exact argmax (same op as device_sample's
+    greedy mode, so a slot stream is byte-identical to a solo greedy
+    run); ``greedy`` is static and compiles an all-greedy batch down to
+    the argmax alone.
+    """
+    arg = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if greedy:
+        return arg
+    t = jnp.maximum(temps, 1e-6)[:, None]
+    probs = softmax_f32(logits / t)  # (B, V)
+    # vectorized nucleus (device_sample semantics per row); top-p outside
+    # (0, 1) degrades to plain multinomial by widening the kept prefix to
+    # the whole vocab
+    sorted_probs, sorted_idx = jax.lax.top_k(probs, probs.shape[-1])
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    tp = jnp.where((topps > 0.0) & (topps < 1.0), topps, 1.0)[:, None]
+    keep = (cum - sorted_probs) < tp
+    filtered = jnp.where(keep, sorted_probs, 0.0)
+    choice = jax.random.categorical(key, jnp.log(filtered), axis=-1)
+    sampled = jnp.take_along_axis(sorted_idx, choice[:, None],
+                                  axis=-1)[:, 0].astype(jnp.int32)
+    return jnp.where(temps == 0.0, arg, sampled)
+
+
+def slot_chunk(params, cfg: ModelConfig, cache: KVCache, tokens: jax.Array,
+               pos_rows: jax.Array, n_valid: jax.Array, key: jax.Array,
+               temps: jax.Array, topps: jax.Array, *, steps: int,
+               greedy: bool):
+    """One continuous-batching dispatch: a mixed prefill/decode forward
+    over (B, T) slot rows, then ``steps - 1`` pure decode steps — all one
+    XLA program, so slot serving keeps decode_chunk's amortization (only
+    (steps, B) int32 ids cross the host boundary).
+
+    Row ``r`` consumes its first ``n_valid[r]`` tokens at positions
+    ``pos_rows[r]..``; its first output token is sampled from its last
+    valid position, and each subsequent step feeds every row its own
+    previous sample.  The scheduler uses ``steps > 1`` (a decode burst)
+    only when no slot is mid-prefill; free rows ride along at position 0
+    and their samples are discarded host-side.
+
+    Returns (tokens (steps, B), cache).  The caller advances per-slot
+    positions host-side (``pos += n_valid``, then +1 per extra step).
+    """
+    logits, cache = forward_slots(params, cfg, tokens, cache, pos_rows,
+                                  n_valid)
+    key, sub = jax.random.split(key)
+    first = device_sample_rows(logits, sub, temps, topps, greedy)
+    pos_rows = pos_rows + n_valid
+
+    def body(carry, _):
+        cache, tok, pos_rows, key = carry
+        logits, cache = forward_slots(params, cfg, tok[:, None], cache,
+                                      pos_rows, jnp.ones_like(pos_rows))
+        key, sub = jax.random.split(key)
+        nxt = device_sample_rows(logits, sub, temps, topps, greedy)
+        return (cache, nxt, pos_rows + 1, key), nxt
+
+    if steps > 1:
+        (cache, _, _, _), rest = jax.lax.scan(
+            body, (cache, first, pos_rows, key), None, length=steps - 1)
+        toks = jnp.concatenate([first[None], rest], axis=0)
+    else:
+        toks = first[None]
+    return toks, cache

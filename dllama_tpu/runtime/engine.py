@@ -967,6 +967,81 @@ class Engine:
                 self._chunk_counter -= 1
 
     # ------------------------------------------------------------------
+    def slot_step(self, tokens_np: np.ndarray, pos_rows_np: np.ndarray,
+                  n_valid_np: np.ndarray, *, temps_np: np.ndarray,
+                  topps_np: np.ndarray, steps: int = 1) -> np.ndarray:
+        """One continuous-batching dispatch over the slot-addressable
+        batch: row ``r`` consumes its first ``n_valid_np[r]`` tokens of
+        ``tokens_np`` (B, T) at its own cache positions
+        ``pos_rows_np[r]..``, then ``steps - 1`` pure decode steps run on
+        device (decode_loop.slot_chunk).  Returns the sampled ids
+        (steps, B).
+
+        This is the primitive the slot scheduler
+        (runtime/scheduler.py) drives: a joining request's prefill chunk
+        and its neighbors' decode tokens share one dispatch, and a freed
+        slot is reused by just handing its row position 0 again — the
+        previous occupant's stale KV sits above the new request's causal
+        ceiling (see ops.attention.slot_gqa_attention_at), so per-slot
+        reset costs nothing.
+
+        Deliberately does NOT touch ``self.pos`` / ``self._offsets``:
+        the one-shot conversation/batch paths and the slot path can share
+        one engine as long as their uses don't overlap in time (the
+        scheduler's ``exclusive()`` guarantees that), and the scheduler
+        tracks every slot's clock host-side.  Compiled per
+        ``(T, steps, all-greedy)``; temperature/top-p ride in as (B,)
+        arrays so heterogeneous requests share one program.
+        """
+        from .decode_loop import slot_chunk
+        if self.sp > 1:
+            raise ValueError("slot serving is not supported on sp meshes "
+                             "(sequence-sharded cache); use sp=1")
+        if self.cache.quantized:
+            raise ValueError("slot serving needs a dense KV cache "
+                             "(per-row quantized writes are not wired)")
+        t = int(tokens_np.shape[1])
+        if steps < 1:
+            raise ValueError("steps must be positive")
+        # dynamic_update_slice clamps out-of-range starts backwards, which
+        # would silently overwrite valid history — refuse instead
+        hi = max(int(np.max(pos_rows_np)) + t,
+                 int(np.max(pos_rows_np + n_valid_np)) + (steps - 1))
+        if hi > self.seq_len:
+            raise ContextOverflow(
+                f"slot step would write position {hi - 1} past seq_len "
+                f"{self.seq_len}; retire rows at the context edge first")
+        greedy = bool(np.all(temps_np == 0.0))
+        key = ("slot", t, steps, greedy)
+        fresh = key not in self._chunk_fns
+        if fresh:
+            cfg = self.cfg
+            self._chunk_fns[key] = jax.jit(
+                lambda p, c, tok, pr, nv, k, tm, tp: slot_chunk(
+                    p, cfg, c, tok, pr, nv, k, tm, tp,
+                    steps=steps, greedy=greedy),
+                donate_argnums=(1,),
+                out_shardings=(self._rep, self._cache_sh))
+        self._note_executable(fresh, key=key)
+        fn = self._chunk_fns[key]
+        sub = jax.random.fold_in(self._key, self._chunk_counter)
+        self._chunk_counter += 1
+        t0 = time.perf_counter()
+        with active_mesh(self.mesh):
+            toks_dev, self.cache = fn(
+                self.params, self.cache, jnp.asarray(tokens_np, jnp.int32),
+                jnp.asarray(pos_rows_np, jnp.int32),
+                jnp.asarray(n_valid_np, jnp.int32), sub,
+                jnp.asarray(temps_np, jnp.float32),
+                jnp.asarray(topps_np, jnp.float32))
+        self._sync(toks_dev, "slot step")
+        t1 = time.perf_counter()
+        if fresh:  # first call blocks through trace + compile
+            obs_metrics.ENGINE_COMPILE_S.observe(t1 - t0)
+        obs_trace.record("slot_step", t0, t1, t=t, steps=steps)
+        return np.asarray(toks_dev)  # (steps, B)
+
+    # ------------------------------------------------------------------
     def score_batch(self, sequences: list[list[int]], top_k: int = 0
                     ) -> tuple[np.ndarray, np.ndarray | None, np.ndarray | None]:
         """Teacher-force B sequences through ONE left-padded ragged forward

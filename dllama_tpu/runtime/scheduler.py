@@ -1,0 +1,429 @@
+"""Continuous-batching slot scheduler over the slot-addressable batch
+engine.
+
+The server's engine mutex serializes whole *requests*: while one stream
+decodes, every other admitted request waits, even though a lockstep batch
+step prices B rows at roughly one weight read (runtime/engine.py
+``generate_batch_stream``).  Iteration-level scheduling (Orca, OSDI'22;
+vLLM's slot form, SOSP'23) moves the admission boundary from the request
+to the *decode step*: this scheduler owns the ``--batch-slots`` engine and
+drives :meth:`Engine.slot_step` from one daemon thread, admitting a new
+request into any free slot between steps and retiring finished ones
+without disturbing their neighbors.
+
+Mechanics per dispatch:
+
+* every active slot is either **prefilling** (its prompt feeds in chunks
+  of ``--sched-prefill-chunk`` tokens, interleaved with its neighbors'
+  decode tokens in the same mixed forward — bounding the inter-token
+  latency a join adds to running streams) or **decoding** (feeds its
+  previous sample);
+* when *no* slot is mid-prefill, decode runs in on-device bursts
+  (``steps > 1`` inside one XLA program, decode_chunk's amortization);
+  with work waiting in the queue the burst is clamped so a finishing
+  stream frees its slot within ``--sched-max-wait-ms``;
+* a freed slot is reused by handing its row position 0 again — the
+  previous occupant's stale KV sits above the newcomer's causal ceiling
+  (ops/attention.py ``slot_gqa_attention_at``), so per-slot reset is
+  free and the cache is never zeroed.
+
+Each submitted request gets a :class:`Ticket` — a thread-safe token
+stream the HTTP handler consumes.  Cancellation (client disconnect, stop
+string, deadline) flips a flag the loop honors at the next step
+boundary, freeing the slot mid-generation.  A dispatch failure
+(StepTimeout, device fault) retires every active slot with the error on
+its ticket and the loop keeps serving — the write-before-visible
+invariant makes any cache garbage from the failed step unobservable.
+
+Greedy determinism contract: a temperature-0 request produces the same
+tokens whichever slot it lands in and whatever its neighbors are doing
+(tests/test_scheduler.py pins this).  Sampled requests draw from the
+engine's shared counter-based RNG stream, so their draws depend on
+co-scheduling — per-request seeds are not reproducible here (use the
+mutex path for that); this is the standard continuous-batching trade.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import queue
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics, trace as obs_trace
+from ..obs.log import get_logger
+
+_log = get_logger("runtime.scheduler")
+
+_DONE = object()  # ticket stream terminator
+
+
+class SchedulerClosed(RuntimeError):
+    """submit() after begin_drain()/close(): no new work is admitted."""
+
+
+class SchedulerSaturated(RuntimeError):
+    """submit() with the wait queue at its bound (the server maps this to
+    429, same as mutex-path admission)."""
+
+
+class Ticket:
+    """One request's handle: a bounded-latency token stream plus the
+    finish verdict.  Produced by the scheduler thread, consumed by the
+    HTTP handler thread; ``cancel`` may be called from either side."""
+
+    def __init__(self, prompt, max_new, temperature, top_p, eos_ids,
+                 deadline):
+        self.prompt = list(prompt)
+        self.max_new = int(max_new)
+        self.temperature = float(temperature)
+        self.top_p = float(top_p)
+        self.eos_ids = tuple(eos_ids)
+        self.deadline = deadline  # time.monotonic() or None
+        self.finish: str | None = None  # stop/length/timeout/aborted/error
+        self.error: BaseException | None = None
+        self.slot: int | None = None
+        self.submitted_at = time.monotonic()
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._cancel: str | None = None
+        self._on_cancel = None  # scheduler wakeup, bound at submit
+
+    def cancel(self, reason: str = "aborted") -> None:
+        """Ask the scheduler to retire this request at the next step
+        boundary (idempotent).  Safe before admission: a queued ticket is
+        dropped without ever occupying a slot."""
+        if self._cancel is None and self.finish is None:
+            self._cancel = reason
+            if self._on_cancel is not None:
+                self._on_cancel()
+
+    def tokens(self):
+        """Yield completion token ids until the request retires.  After
+        the generator ends, ``finish`` holds the verdict; a scheduler-side
+        failure re-raises here on the consumer's thread."""
+        while True:
+            item = self._q.get()
+            if item is _DONE:
+                break
+            yield item
+        if self.error is not None:
+            raise self.error
+
+
+class _Slot:
+    __slots__ = ("ticket", "pos", "fed", "produced", "last")
+
+    def __init__(self):
+        self.ticket: Ticket | None = None
+        self.pos = 0        # this row's cache clock
+        self.fed = 0        # prompt tokens consumed so far
+        self.produced = 0   # completion tokens emitted
+        self.last = 0       # previous sample (decode feedback)
+
+
+class SlotScheduler:
+    """Owns the batch engine; see the module docstring.  ``max_queue``
+    bounds requests waiting for a slot (beyond it submit() raises
+    :class:`SchedulerSaturated`)."""
+
+    def __init__(self, engine, *, prefill_chunk: int = 16,
+                 max_wait_ms: float = 50.0, decode_burst: int = 16,
+                 max_queue: int = 32):
+        if engine.sp > 1:
+            raise ValueError("slot scheduling is not supported on sp meshes")
+        if engine.cache.quantized:
+            raise ValueError("slot scheduling needs a dense KV cache")
+        self.engine = engine
+        self.slots = [_Slot() for _ in range(engine.batch)]
+        self.prefill_chunk = max(1, int(prefill_chunk))
+        self.max_wait_ms = float(max_wait_ms)
+        self.decode_burst = max(1, int(decode_burst))
+        self.max_queue = max(1, int(max_queue))
+        self._queue: deque[Ticket] = deque()
+        self._cond = threading.Condition()
+        self._draining = False
+        self._stop = False
+        self._idle = threading.Event()  # set while paused with empty slots
+        self._paused = 0
+        self._step_ms_ema: float | None = None
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dllama-slot-scheduler")
+        self._thread.start()
+
+    # -- submission-side API -------------------------------------------
+    def submit(self, prompt: list[int], max_new: int, *,
+               temperature: float = 0.0, top_p: float = 0.9,
+               eos_ids: tuple[int, ...] = (),
+               deadline: float | None = None) -> Ticket:
+        """Queue one request; returns its :class:`Ticket` immediately.
+        ``deadline`` is a ``time.monotonic()`` instant (the server's
+        per-request deadline); an expired request retires with finish
+        ``timeout`` and whatever tokens it produced."""
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new < 1:
+            raise ValueError("max_new must be positive")
+        t = Ticket(prompt, max_new, temperature, top_p, eos_ids, deadline)
+        with self._cond:
+            if self._stop or self._draining:
+                raise SchedulerClosed("scheduler is draining")
+            # admission runs on the scheduler thread, so just-submitted
+            # tickets sit in the queue for one beat even when slots are
+            # free — the bound is on work beyond what free slots will
+            # immediately absorb, not on that scheduling gap
+            free = sum(1 for s in self.slots if s.ticket is None)
+            if len(self._queue) >= self.max_queue + (0 if self._paused
+                                                     else free):
+                raise SchedulerSaturated(
+                    f"{len(self._queue)} requests already waiting")
+            t._on_cancel = self._wake
+            self._queue.append(t)
+            self._cond.notify_all()
+        return t
+
+    def occupancy(self) -> dict:
+        """Live state for /health and the over-n error body."""
+        with self._cond:
+            active = sum(1 for s in self.slots if s.ticket is not None)
+            return {"slots": len(self.slots), "active": active,
+                    "queued": len(self._queue)}
+
+    def begin_drain(self, deadline: float | None) -> None:
+        """Stop admitting new submissions and clamp every in-flight and
+        queued request's deadline — drain then *waits* for the slots via
+        the handlers consuming their tickets."""
+        with self._cond:
+            self._draining = True
+            for t in list(self._queue):
+                t.deadline = min(t.deadline, deadline) \
+                    if (t.deadline and deadline) else (t.deadline or deadline)
+            for s in self.slots:
+                if s.ticket is not None:
+                    t = s.ticket
+                    t.deadline = min(t.deadline, deadline) \
+                        if (t.deadline and deadline) else (t.deadline or deadline)
+            self._cond.notify_all()
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the loop; any still-live tickets retire as ``aborted`` so
+        no consumer blocks forever."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+
+    @contextlib.contextmanager
+    def exclusive(self):
+        """Park the scheduler and wait until every slot has retired, so
+        the caller may run one-shot batch-engine work (list-prompt
+        lockstep, n>1 fan-out, logprobs scoring) that resets the shared
+        cache.  Admission pauses; queued requests keep their place."""
+        with self._cond:
+            self._paused += 1
+            self._cond.notify_all()
+        self._idle.wait()
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._paused -= 1
+                if self._paused == 0:
+                    self._idle.clear()
+                self._cond.notify_all()
+
+    def _wake(self):
+        with self._cond:
+            self._cond.notify_all()
+
+    # -- scheduler thread ----------------------------------------------
+    def _retire(self, slot_idx: int, reason: str,
+                error: BaseException | None = None) -> None:
+        s = self.slots[slot_idx]
+        t = s.ticket
+        if t is None:
+            return
+        t.finish = reason
+        t.error = error
+        s.ticket = None
+        obs_metrics.SCHED_SLOT_RETIRES.inc(slot_idx, reason)
+        now = time.monotonic()
+        obs_trace.record("sched_retire", now, now, slot=slot_idx,
+                         reason=reason, produced=s.produced)
+        _log.info("slot retire", extra={
+            "slot": slot_idx, "reason": reason, "produced": s.produced})
+        t._q.put(_DONE)
+
+    def _fail_ticket(self, t: Ticket, reason: str,
+                     error: BaseException | None = None) -> None:
+        t.finish = reason
+        t.error = error
+        t._q.put(_DONE)
+
+    def _admit_locked(self, now: float) -> None:
+        """Move queued tickets into free slots (caller holds the lock)."""
+        for i, s in enumerate(self.slots):
+            if s.ticket is not None or not self._queue:
+                continue
+            t = self._queue.popleft()
+            if t._cancel is not None:
+                self._fail_ticket(t, t._cancel)
+                continue
+            if t.deadline is not None and now >= t.deadline:
+                self._fail_ticket(t, "timeout")
+                continue
+            s.ticket = t
+            s.pos = 0
+            s.fed = 0
+            s.produced = 0
+            s.last = 0
+            t.slot = i
+            obs_metrics.SCHED_SLOT_JOINS.inc(i)
+            obs_trace.record("sched_admit", t.submitted_at, now, slot=i,
+                             queued_ms=round((now - t.submitted_at) * 1e3, 3),
+                             n_prompt=len(t.prompt))
+            _log.info("slot join", extra={
+                "slot": i, "n_prompt": len(t.prompt),
+                "queued_ms": round((now - t.submitted_at) * 1e3, 3)})
+
+    def _active(self) -> list[int]:
+        return [i for i, s in enumerate(self.slots) if s.ticket is not None]
+
+    def _run(self) -> None:
+        try:
+            while True:
+                with self._cond:
+                    now = time.monotonic()
+                    # honor cancels/deadlines first so their slots free up
+                    for i in self._active():
+                        t = self.slots[i].ticket
+                        if t._cancel is not None:
+                            self._retire(i, t._cancel)
+                        elif t.deadline is not None and now >= t.deadline:
+                            self._retire(i, "timeout")
+                    for t in [q for q in self._queue
+                              if q._cancel is not None
+                              or (q.deadline is not None and now >= q.deadline)]:
+                        self._queue.remove(t)
+                        self._fail_ticket(t, t._cancel or "timeout")
+                    if not self._paused:
+                        self._admit_locked(now)
+                    active = self._active()
+                    queued = len(self._queue)
+                    obs_metrics.SCHED_SLOTS_OCCUPIED.set(len(active))
+                    obs_metrics.SCHED_QUEUE_DEPTH.set(queued)
+                    if self._stop:
+                        return
+                    if not active:
+                        if self._paused:
+                            self._idle.set()
+                        # parked: submissions/cancels/close notify; the
+                        # short timeout re-checks queued deadlines
+                        self._cond.wait(0.1)
+                        continue
+                self._dispatch(active, queued)
+        except BaseException as e:  # loop must not die silently
+            _log.error("scheduler loop failed", extra={"error": repr(e)})
+            raise
+        finally:
+            with self._cond:
+                for i in self._active():
+                    self._retire(i, "aborted")
+                while self._queue:
+                    self._fail_ticket(self._queue.popleft(), "aborted")
+                self._idle.set()
+
+    def _dispatch(self, active: list[int], queued: int) -> None:
+        eng = self.engine
+        b = eng.batch
+        slots = self.slots
+        prefilling = [i for i in active
+                      if slots[i].fed < len(slots[i].ticket.prompt)]
+        room = min(eng.seq_len - slots[i].pos for i in active)
+        if prefilling:
+            # mixed step: prefill chunks ride along with the decode rows'
+            # single tokens; steps=1 keeps every row's clock advancing by
+            # its own n_valid
+            t_width = min(self.prefill_chunk, room,
+                          max(len(slots[i].ticket.prompt) - slots[i].fed
+                              for i in prefilling))
+            steps = 1
+        else:
+            # pure decode: burst on device, clamped so (a) no row outruns
+            # its budget/window and (b) queued work waits at most
+            # ~max_wait_ms for the next admission boundary
+            t_width = 1
+            steps = min(self.decode_burst, room,
+                        min(slots[i].ticket.max_new - slots[i].produced
+                            for i in active))
+            if queued and self._step_ms_ema:
+                steps = min(steps, max(
+                    1, int(self.max_wait_ms / self._step_ms_ema)))
+            steps = max(1, steps)
+
+        tokens = np.zeros((b, t_width), np.int32)
+        n_valid = np.ones((b,), np.int32)
+        pos_rows = np.zeros((b,), np.int32)
+        temps = np.zeros((b,), np.float32)
+        topps = np.full((b,), 0.9, np.float32)
+        for i in active:
+            s = slots[i]
+            pos_rows[i] = s.pos
+            temps[i] = s.ticket.temperature
+            topps[i] = s.ticket.top_p
+            if s.fed < len(s.ticket.prompt):
+                c = min(t_width, len(s.ticket.prompt) - s.fed)
+                tokens[i, :c] = s.ticket.prompt[s.fed:s.fed + c]
+                n_valid[i] = c
+            else:
+                tokens[i, 0] = s.last
+
+        obs_metrics.SCHED_BATCH_EFFICIENCY.set(len(active) / b)
+        t0 = time.monotonic()
+        try:
+            out = eng.slot_step(tokens, pos_rows, n_valid,
+                                temps_np=temps, topps_np=topps, steps=steps)
+        except Exception as e:
+            # a failed dispatch poisons at most this step: retire every
+            # active slot with the error and keep serving — stale cache
+            # garbage sits above future occupants' causal ceilings
+            _log.error("slot dispatch failed", extra={"error": repr(e)})
+            with self._cond:
+                for i in self._active():
+                    self._retire(i, "error", error=e)
+            return
+        step_ms = (time.monotonic() - t0) * 1e3 / steps
+        self._step_ms_ema = step_ms if self._step_ms_ema is None \
+            else 0.8 * self._step_ms_ema + 0.2 * step_ms
+        obs_trace.record("sched_step", t0, time.monotonic(),
+                         active=len(active), queued=queued,
+                         t=t_width, steps=steps)
+
+        for j in range(steps):
+            for i in active:
+                s = slots[i]
+                t = s.ticket
+                if t is None:  # retired earlier this burst
+                    continue
+                tok = int(out[j, i])
+                if j == 0 and s.fed < len(t.prompt):
+                    s.fed += int(n_valid[i])
+                    s.pos += int(n_valid[i])
+                    if s.fed < len(t.prompt):
+                        continue  # mid-prefill: sample not meaningful yet
+                    # prefill just completed: this sample IS the first
+                    # completion token — fall through to emit it
+                else:
+                    s.pos += 1
+                s.last = tok
+                if tok in t.eos_ids:
+                    with self._cond:
+                        self._retire(i, "stop")
+                    continue
+                s.produced += 1
+                t._q.put(tok)
+                if s.produced >= t.max_new or s.pos >= eng.seq_len:
+                    with self._cond:
+                        self._retire(i, "length")

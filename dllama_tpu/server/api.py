@@ -11,6 +11,14 @@ Re-implements `/root/reference/src/apps/dllama-api/dllama-api.cpp`:
   reference (the reference is strictly batch=1, tasks.cpp:199-210) and
   the TPU serving-throughput lever: the decode matmuls amortize one
   weight read over all rows.  Enabled with ``--batch-slots N``.
+* **continuous batching** (``--batch-slots`` + runtime/scheduler.py):
+  single-prompt completions and spillover chat requests join the batch
+  engine at *decode-step* granularity — a request admitted mid-decode
+  prefills in ``--sched-prefill-chunk`` chunks interleaved with its
+  neighbors' tokens, and a finished stream frees its slot within
+  ``--sched-max-wait-ms`` without stopping the batch.  Seeded sampling,
+  logprobs, echo, list prompts, and ``n>1`` stay on the mutex/lockstep
+  paths (see ``Handler._sched_eligible``).
 * ``GET /v1/models`` — stub model list (:387-393).
 * **NaiveCache** (:187-232): if a new request's messages extend the cached
   conversation prefix exactly, generation resumes from the cached KV
@@ -69,6 +77,8 @@ from ..obs.log import (configure as configure_logging, get_logger,
                        new_request_id, set_request_id)
 from ..runtime.engine import ContextOverflow, Engine, NumericFault, StepTimeout
 from ..runtime.faults import FAULTS
+from ..runtime.scheduler import (SchedulerClosed, SchedulerSaturated,
+                                 SlotScheduler)
 from ..runtime.stream import drain_generation
 from ..tokenizer.bpe import Tokenizer
 from ..tokenizer.chat import ChatItem, ChatTemplate, TokenizerChatStops
@@ -309,10 +319,12 @@ class ApiState:
                  batch_engine: Engine | None = None,
                  max_pending: int = 8, request_timeout: float = 0.0,
                  io_timeout: float = 15.0, drain_grace: float = 30.0,
-                 snapshot_dir: str | None = None):
+                 snapshot_dir: str | None = None,
+                 scheduler: SlotScheduler | None = None):
         self.engine = engine
         self.snapshot_dir = snapshot_dir
         self.batch_engine = batch_engine
+        self.scheduler = scheduler
         self.tokenizer = tokenizer
         self.default_temperature = default_temperature
         self.default_topp = default_topp
@@ -368,6 +380,10 @@ class ApiState:
             self.draining = True
             g = self.drain_grace if grace is None else grace
             self.drain_deadline = time.monotonic() + max(g, 0.0)
+        if self.scheduler is not None:
+            # slot-path requests drain too: no new submissions, every
+            # in-flight and queued ticket's deadline clamps to the grace
+            self.scheduler.begin_drain(self.drain_deadline)
 
     # -- engine-state snapshot (warm restart; runtime/snapshot.py) ------
     @property
@@ -487,6 +503,10 @@ class ApiState:
             "mesh": {k: int(v) for k, v in dict(eng.mesh.shape).items()},
             "seq_len": eng.seq_len,
             "batch_slots": self.batch_engine.batch if self.batch_engine else 0,
+            # slot-scheduler occupancy (satellite: /health must surface it
+            # alongside batch_slots so an over-n client can size retries)
+            "scheduler": self.scheduler.occupancy()
+            if self.scheduler is not None else None,
             "in_flight": in_flight,
             "queued": queued,
             "max_pending": self.max_pending,
@@ -574,6 +594,26 @@ class ApiState:
         return reply, len(prompt_tokens), n_completion, finish
 
     # ------------------------------------------------------------------
+    def overflow_body(self, e: Exception) -> dict:
+        """Error body for a batch-capacity 4xx: the message plus the
+        server's slot count and live scheduler occupancy, so a client
+        that sent too many prompts (or too large an ``n``) can split the
+        work without a second probing request."""
+        body: dict = {"error": str(e)}
+        if self.batch_engine is not None:
+            body["batch_slots"] = self.batch_engine.batch
+        if self.scheduler is not None:
+            body["scheduler"] = self.scheduler.occupancy()
+        return body
+
+    def _batch_exclusive(self):
+        """One-shot batch-engine work (list-prompt lockstep, n>1 fan-out,
+        logprobs scoring) resets the shared KV cache, which would corrupt
+        any live slot rows — park the scheduler first."""
+        if self.scheduler is not None:
+            return self.scheduler.exclusive()
+        return contextlib.nullcontext()
+
     def _plan_ids(self, id_lists: list[list[int]], max_tokens: int,
                   eos_id: int) -> tuple[list[list[int]], int, int, int]:
         """THE batched-serving validation/padding/budget recipe — single
@@ -606,7 +646,8 @@ class ApiState:
 
     def _drain_batch(self, id_lists: list[list[int]], budget: int, *,
                      temperature: float, top_p: float, seed: int | None,
-                     eos_id: int, deadline: float | None = None
+                     eos_id: int, deadline: float | None = None,
+                     n_real: int | None = None
                      ) -> tuple[list[list[int]], list[bool]]:
         """Consume one lockstep batch generation (Engine.generate_batch
         semantics: per-row EOS/budget truncation) with a deadline check
@@ -615,30 +656,43 @@ class ApiState:
         keep whatever they had decoded.  The batch engine is one-shot
         (reset precedes every use), so early exit needs no pos rewind —
         only the generator close, which returns the speculative chunk's
-        RNG tick (engine contract)."""
+        RNG tick (engine contract).
+
+        ``n_real``: rows past it are ``_plan_ids`` padding — they decode
+        on device (lockstep has no ragged exit) but are masked out of
+        every host-side step: no detokenization, no EOS scan, and no say
+        in the early-exit vote, so a short real batch finishes as soon as
+        its REAL rows do.  The pad fraction is what the batch-efficiency
+        gauge reports."""
         eng = self.batch_engine
-        eng.reset()
+        if n_real is None:
+            n_real = len(id_lists)
+        obs_metrics.SCHED_BATCH_EFFICIENCY.set(n_real / eng.batch)
         outs = [list(p) for p in id_lists]
-        done = [len(o) >= budget for o in outs]
+        done = [len(o) >= budget or r >= n_real
+                for r, o in enumerate(outs)]
         timed = [False] * len(outs)
-        stream = eng.generate_batch_stream(
-            id_lists, budget, temperature=temperature, topp=top_p,
-            seed=seed if seed is not None else int(time.time()),
-            chunk=self.chunk)
-        with contextlib.closing(stream):
-            for row_tokens in stream:
-                for r, t in enumerate(row_tokens.tolist()):
-                    if done[r]:
-                        continue
-                    outs[r].append(int(t))
-                    if int(t) == eos_id or len(outs[r]) >= budget:
-                        done[r] = True
-                if all(done):
-                    break
-                d = self.effective_deadline(deadline)
-                if d is not None and time.monotonic() >= d:
-                    timed = [not dn for dn in done]
-                    break
+        with self._batch_exclusive():
+            eng.reset()
+            stream = eng.generate_batch_stream(
+                id_lists, budget, temperature=temperature, topp=top_p,
+                seed=seed if seed is not None else int(time.time()),
+                chunk=self.chunk)
+            with contextlib.closing(stream):
+                for row_tokens in stream:
+                    for r, t in enumerate(row_tokens.tolist()):
+                        if done[r]:
+                            continue
+                        outs[r].append(int(t))
+                        if int(t) == eos_id or len(outs[r]) >= budget:
+                            done[r] = True
+                    if all(done):
+                        break
+                    d = self.effective_deadline(deadline)
+                    if d is not None and time.monotonic() >= d:
+                        timed = [not dn and r < n_real
+                                 for r, dn in enumerate(done)]
+                        break
         return outs, timed
 
     def complete_n(self, params: InferenceParams,
@@ -665,7 +719,7 @@ class ApiState:
         outs, timed = self._drain_batch(
             id_lists, budget, temperature=params.temperature,
             top_p=params.top_p, seed=params.seed, eos_id=eos_id,
-            deadline=deadline)
+            deadline=deadline, n_real=params.n)
         choices = []
         n_completion = 0
         for r in range(params.n):
@@ -727,7 +781,7 @@ class ApiState:
         id_lists, n_real, budget, eos_id = self.plan_batch(prompts, max_tokens)
         outs, timed = self._drain_batch(
             id_lists, budget, temperature=temperature, top_p=top_p,
-            seed=seed, eos_id=eos_id, deadline=deadline)
+            seed=seed, eos_id=eos_id, deadline=deadline, n_real=n_real)
         choices = []
         comps = []
         n_prompt = n_completion = 0
@@ -795,7 +849,8 @@ class ApiState:
         seqs = [id_lists[r] + comps[r] if r < n_real else list(id_lists[r])
                 for r in range(eng.batch)]
         seqs = [s if len(s) >= 2 else s + [0] for s in seqs]
-        tok_lp, top_ids, top_lp = eng.score_batch(seqs, top_k=top_k)
+        with self._batch_exclusive():
+            tok_lp, top_ids, top_lp = eng.score_batch(seqs, top_k=top_k)
         bucket = tok_lp.shape[1]
         for r in range(n_real):
             text = choices[r]["text"]
@@ -883,7 +938,7 @@ class ApiState:
         eng, tok = self.batch_engine, self.tokenizer
         id_lists, n_real, budget, eos_id = \
             plan if plan is not None else self.plan_batch(prompts, max_tokens)
-        eng.reset()
+        obs_metrics.SCHED_BATCH_EFFICIENCY.set(n_real / eng.batch)
         decoders = [codecs.getincrementaldecoder("utf-8")("replace")
                     for _ in range(n_real)]
         hold = max((len(s) for s in stop), default=0)
@@ -917,49 +972,129 @@ class ApiState:
                 emit(r, buf[r], None)
                 buf[r] = ""
 
-        stream = eng.generate_batch_stream(
-            id_lists, budget, temperature=temperature, topp=top_p,
-            seed=seed if seed is not None else int(time.time()),
-            chunk=self.chunk)
-        with contextlib.closing(stream):
-            for step_vec in stream:
-                for r in range(n_real):
-                    if done[r]:
-                        continue
-                    t = int(step_vec[r])
-                    n_comp[r] += 1
-                    if t == eos_id:
-                        # eos text never enters the reply; flush and close as
-                        # "stop" (a stop string firing in the buffer also ends
-                        # the row as "stop" — flush handles both)
-                        buf[r] += decoders[r].decode(b"", True)
-                        flush(r, closing=True, finish="stop")
-                        continue
-                    buf[r] += decoders[r].decode(tok.decode_piece(prev[r], t))
-                    prev[r] = t
-                    if n_comp[r] >= cap[r]:
-                        buf[r] += decoders[r].decode(b"", True)
-                        flush(r, closing=True)
-                    else:
-                        flush(r, closing=False)
-                if all(done):
-                    break
-                if is_aborted is not None and is_aborted():
-                    return  # client gone: nothing left worth decoding
-                d = self.effective_deadline(deadline)
-                if d is not None and time.monotonic() >= d:
-                    # deadline between chunks: close every live row as a
-                    # well-formed truncated stream (OpenAI shape, the
-                    # chat path's finish_reason="timeout" contract)
+        with self._batch_exclusive():
+            eng.reset()
+            stream = eng.generate_batch_stream(
+                id_lists, budget, temperature=temperature, topp=top_p,
+                seed=seed if seed is not None else int(time.time()),
+                chunk=self.chunk)
+            with contextlib.closing(stream):
+                for step_vec in stream:
                     for r in range(n_real):
-                        if not done[r]:
+                        if done[r]:
+                            continue
+                        t = int(step_vec[r])
+                        n_comp[r] += 1
+                        if t == eos_id:
+                            # eos text never enters the reply; flush and close
+                            # as "stop" (a stop string firing in the buffer
+                            # also ends the row as "stop" — flush handles both)
                             buf[r] += decoders[r].decode(b"", True)
-                            flush(r, closing=True, finish="timeout")
-                    return
+                            flush(r, closing=True, finish="stop")
+                            continue
+                        buf[r] += decoders[r].decode(
+                            tok.decode_piece(prev[r], t))
+                        prev[r] = t
+                        if n_comp[r] >= cap[r]:
+                            buf[r] += decoders[r].decode(b"", True)
+                            flush(r, closing=True)
+                        else:
+                            flush(r, closing=False)
+                    if all(done):
+                        break
+                    if is_aborted is not None and is_aborted():
+                        return  # client gone: nothing left worth decoding
+                    d = self.effective_deadline(deadline)
+                    if d is not None and time.monotonic() >= d:
+                        # deadline between chunks: close every live row as a
+                        # well-formed truncated stream (OpenAI shape, the
+                        # chat path's finish_reason="timeout" contract)
+                        for r in range(n_real):
+                            if not done[r]:
+                                buf[r] += decoders[r].decode(b"", True)
+                                flush(r, closing=True, finish="timeout")
+                        return
         for r in range(n_real):
             if not done[r]:  # budget exhausted with text still buffered
                 buf[r] += decoders[r].decode(b"", True)
                 flush(r, closing=True)
+
+    # -- continuous batching (runtime/scheduler.py) --------------------
+    def sched_submit(self, prompt_tokens: list[int], max_tokens: int, *,
+                     temperature: float, top_p: float, eos_id: int,
+                     deadline: float | None):
+        """Validate and submit one request to the slot scheduler.  Split
+        from :meth:`sched_drain` so streaming handlers can 400/429/503
+        BEFORE committing to SSE headers.  Raises ContextOverflow /
+        SchedulerClosed / SchedulerSaturated."""
+        eng = self.scheduler.engine
+        if not prompt_tokens:
+            raise ContextOverflow("a prompt encoded to zero tokens")
+        if len(prompt_tokens) + 1 >= eng.seq_len:
+            raise ContextOverflow(
+                f"prompt needs {len(prompt_tokens)} of {eng.seq_len} "
+                "context positions")
+        max_new = eng.seq_len - len(prompt_tokens)
+        if max_tokens > 0:
+            max_new = min(max_new, max_tokens)
+        return self.scheduler.submit(
+            prompt_tokens, max_new, temperature=temperature, top_p=top_p,
+            eos_ids=(eos_id,), deadline=self.effective_deadline(deadline))
+
+    def sched_drain(self, ticket, prev: int, *, stop: list[str], emit,
+                    is_aborted=None) -> tuple[str, int, str]:
+        """Consume one ticket's token stream: incremental UTF-8 decode
+        plus the same ``max(len(stop))-1`` hold-back scan as
+        :meth:`complete_batch_stream`, so slot-path stream ≡ non-stream
+        for the same request.  Calls ``emit(delta, finish_or_None)`` as
+        text becomes safe; returns ``(text, n_completion_tokens,
+        finish)`` with finish stop/length/timeout/aborted.  A scheduler-
+        side failure (StepTimeout, device fault) re-raises here, on this
+        handler's thread."""
+        import codecs
+        tok = self.tokenizer
+        dec = codecs.getincrementaldecoder("utf-8")("replace")
+        hold = max((len(s) for s in stop), default=0)
+        parts: list[str] = []
+        buf = ""
+        n_comp = 0
+
+        def push(delta, finish):
+            parts.append(delta)
+            emit(delta, finish)
+
+        stopped = False
+        for t in ticket.tokens():
+            if is_aborted is not None and is_aborted():
+                ticket.cancel("aborted")
+                break
+            n_comp += 1
+            buf += dec.decode(tok.decode_piece(prev, t))
+            prev = t
+            cuts = [c for c in (buf.find(s) for s in stop) if c != -1]
+            if cuts:
+                # the generation keeps running until the scheduler honors
+                # the cancel; tokens past the stop are never decoded here
+                ticket.cancel("stop")
+                push(buf[:min(cuts)], "stop")
+                stopped = True
+                break
+            if hold and len(buf) >= hold:
+                push(buf[:len(buf) - (hold - 1)], None)
+                buf = buf[len(buf) - (hold - 1):]
+            elif not hold and buf:
+                push(buf, None)
+                buf = ""
+        if stopped:
+            return "".join(parts), n_comp, "stop"
+        finish = ticket.finish or "aborted"
+        buf += dec.decode(b"", True)
+        cuts = [c for c in (buf.find(s) for s in stop) if c != -1]
+        if cuts:
+            buf = buf[:min(cuts)]
+            finish = "stop"
+        push(buf, finish)
+        return "".join(parts), n_comp, finish
 
 
 def make_handler(state: ApiState):
@@ -1143,7 +1278,7 @@ def make_handler(state: ApiState):
                 try:
                     plan = state.plan_batch(prompts, max_tokens)
                 except ContextOverflow as e:
-                    self._json(400, {"error": str(e)})
+                    self._json(400, state.overflow_body(e))
                     return
                 # SSE chunks carry per-row deltas tagged by choice index —
                 # every live row streams concurrently from the one
@@ -1212,7 +1347,7 @@ def make_handler(state: ApiState):
                     max_tokens=max_tokens, seed=seed, stop=stop, echo=echo,
                     logprobs=logprobs, deadline=deadline)
             except ContextOverflow as e:
-                self._json(400, {"error": str(e)})
+                self._json(400, state.overflow_body(e))
                 return
             if any(c["finish_reason"] == "timeout" for c in choices):
                 state.metrics.bump("deadline_timeouts")
@@ -1336,6 +1471,251 @@ def make_handler(state: ApiState):
                 "ops": ops,
             })
 
+        def _sched_eligible(self, body: dict) -> bool:
+            """True when this request can ride the slot scheduler
+            (tentpole: decode-step admission instead of the engine
+            mutex).  The mutex path keeps everything the slot engine
+            cannot express: multi-prompt lockstep, n>1, logprobs scoring,
+            echo, and seeded sampling (slot rows share the engine's RNG
+            stream, so per-request seeds are only reproducible when the
+            request owns the engine — greedy requests are exact on both
+            paths)."""
+            if state.scheduler is None:
+                return False
+            try:
+                if int(body.get("n") or 1) != 1:
+                    return False
+                temperature = float(body["temperature"]) \
+                    if body.get("temperature") is not None \
+                    else state.default_temperature
+            except (TypeError, ValueError):
+                return False  # malformed: the mutex handlers own the 400
+            if body.get("seed") is not None and temperature != 0.0:
+                return False
+            if self.path == "/v1/completions":
+                return not isinstance(body.get("prompt"), list) \
+                    and body.get("logprobs") is None \
+                    and not body.get("echo")
+            return True
+
+        def _submit_or_reject(self, ids, max_tokens, *, temperature,
+                              top_p, eos_id, deadline):
+            """sched_submit with every refusal mapped to its HTTP answer
+            (the same codes the mutex path's admission uses).  Returns
+            the ticket, or None when a response was already sent."""
+            try:
+                return state.sched_submit(
+                    ids, max_tokens, temperature=temperature, top_p=top_p,
+                    eos_id=eos_id, deadline=deadline)
+            except ContextOverflow as e:
+                self._json(400, state.overflow_body(e))
+            except SchedulerSaturated as e:
+                state.metrics.bump("requests_rejected_429")
+                self._json(429, state.overflow_body(e),
+                           headers={"Retry-After": state.retry_after_hint()})
+            except SchedulerClosed:
+                state.metrics.bump("requests_rejected_503")
+                self._json(503, {"error": "server is draining; "
+                                          "no new requests accepted"},
+                           headers={"Retry-After": 30})
+            return None
+
+        def _completions_sched(self, body: dict, deadline: float | None,
+                               timer: _StreamTimer | None = None):
+            """Single-prompt /v1/completions over the slot scheduler:
+            joins a batch slot at the next decode-step boundary instead
+            of waiting for the engine mutex."""
+            try:
+                prompt = body.get("prompt")
+                text = str(prompt or "")
+                if not text:
+                    self._json(400, {"error": "prompt required"})
+                    return
+                temperature = float(body["temperature"]) \
+                    if body.get("temperature") is not None \
+                    else state.default_temperature
+                top_p = float(body["top_p"]) \
+                    if body.get("top_p") is not None else state.default_topp
+                max_tokens = int(body.get("max_tokens") or 0)
+                stop = body.get("stop")
+                stop = [stop] if isinstance(stop, str) else \
+                    [str(s) for s in stop] if isinstance(stop, list) else []
+                stream = bool(body.get("stream"))
+            except (TypeError, ValueError) as e:
+                self._json(400, {"error": f"bad request: {e}"})
+                return
+            tok = state.tokenizer
+            ids = tok.encode(text,
+                             add_bos=state.scheduler.engine.cfg.add_bos)
+            eos_id = tok.eos_id if tok.eos_id >= 0 else tok.chat_eos_id
+            # submit BEFORE any SSE commitment so capacity/overflow
+            # refusals answer with their proper status codes
+            ticket = self._submit_or_reject(
+                ids, max_tokens, temperature=temperature, top_p=top_p,
+                eos_id=eos_id, deadline=deadline)
+            if ticket is None:
+                return
+            created = int(time.time())
+            cid = f"cmpl-{uuid.uuid4().hex[:12]}"
+            if stream:
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self._rid_header()
+                self.end_headers()
+                aborted = [False]
+
+                def emit(delta, finish):
+                    if aborted[0]:
+                        return
+                    try:
+                        e0 = time.perf_counter()
+                        FAULTS.fire("server.emit_delta")
+                        chunk = {"id": cid, "object": "text_completion",
+                                 "created": created,
+                                 "model": state.model_name,
+                                 "choices": [{"text": delta, "index": 0,
+                                              "finish_reason": finish,
+                                              "logprobs": None}]}
+                        self.wfile.write(
+                            f"data: {json.dumps(chunk)}\n\n".encode())
+                        self.wfile.flush()
+                        obs_trace.record("emit", e0, time.perf_counter())
+                        if timer is not None:
+                            timer.tick()
+                        if finish == "timeout":
+                            state.metrics.bump("deadline_timeouts")
+                    except OSError:
+                        aborted[0] = True
+                        state.metrics.bump("client_disconnects")
+
+                try:
+                    state.sched_drain(ticket, ids[-1], stop=stop,
+                                      emit=emit,
+                                      is_aborted=lambda: aborted[0])
+                except Exception as e:
+                    ticket.cancel("aborted")
+                    err = {"error": {"message": str(e),
+                                     "type": "server_error"}}
+                    self._safe_write(f"data: {json.dumps(err)}\n\n".encode()
+                                     + b"data: [DONE]\n\n", aborted)
+                    raise
+                self._safe_write(b"data: [DONE]\n\n", aborted)
+                return
+            emit = (lambda d, f: timer.tick()) if timer is not None \
+                else (lambda d, f: None)
+            try:
+                reply, n_comp, finish = state.sched_drain(
+                    ticket, ids[-1], stop=stop, emit=emit)
+            finally:
+                ticket.cancel("aborted")  # no-op unless we errored out
+            if finish == "timeout":
+                state.metrics.bump("deadline_timeouts")
+            self._json(200, {
+                "id": cid, "object": "text_completion", "created": created,
+                "model": state.model_name,
+                "choices": [{"text": reply, "index": 0,
+                             "finish_reason": finish, "logprobs": None}],
+                "usage": {"prompt_tokens": len(ids),
+                          "completion_tokens": n_comp,
+                          "total_tokens": len(ids) + n_comp}})
+
+        def _chat_sched(self, body: dict, deadline: float | None,
+                        timer: _StreamTimer | None = None):
+            """Chat spillover path: a second concurrent conversation
+            joins a batch slot instead of queueing on the engine mutex.
+            The NaiveCache is neither consulted nor updated — the slot
+            engine prefills the full templated history (prefix-resume
+            stays a mutex-path feature)."""
+            try:
+                params = parse_request(body, state.default_temperature,
+                                       state.default_topp)
+                if not params.messages:
+                    self._json(400, {"error": "messages required"})
+                    return
+            except (TypeError, ValueError) as e:
+                self._json(400, {"error": f"bad request: {e}"})
+                return
+            tok = state.tokenizer
+            items = [ChatItem(m.role, m.content) for m in params.messages]
+            ids = tok.encode(state.template.generate(items, True),
+                             add_bos=True)
+            stops = state.base_stops + params.stop
+            ticket = self._submit_or_reject(
+                ids, params.max_tokens, temperature=params.temperature,
+                top_p=params.top_p, eos_id=tok.chat_eos_id,
+                deadline=deadline)
+            if ticket is None:
+                return
+            created = int(time.time())
+            cid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
+            if params.stream:
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self._rid_header()
+                self.end_headers()
+                aborted = [False]
+
+                def emit(delta, finish):
+                    if aborted[0] or not delta:
+                        return
+                    try:
+                        e0 = time.perf_counter()
+                        FAULTS.fire("server.emit_delta")
+                        chunk = {"id": cid,
+                                 "object": "chat.completion.chunk",
+                                 "created": created,
+                                 "model": state.model_name,
+                                 "choices": [{"index": 0,
+                                              "delta": {"content": delta},
+                                              "finish_reason": None}]}
+                        self.wfile.write(
+                            f"data: {json.dumps(chunk)}\n\n".encode())
+                        self.wfile.flush()
+                        obs_trace.record("emit", e0, time.perf_counter())
+                        if timer is not None:
+                            timer.tick()
+                    except OSError:
+                        aborted[0] = True
+                        state.metrics.bump("client_disconnects")
+
+                _, _, finish = state.sched_drain(
+                    ticket, ids[-1], stop=stops, emit=emit,
+                    is_aborted=lambda: aborted[0])
+                if finish == "aborted" or aborted[0]:
+                    return  # nobody is listening
+                if finish == "length":
+                    finish = "stop"  # the chat budget contract (complete())
+                if finish == "timeout":
+                    state.metrics.bump("deadline_timeouts")
+                final = {"id": cid, "object": "chat.completion.chunk",
+                         "created": created, "model": state.model_name,
+                         "choices": [{"index": 0, "delta": {},
+                                      "finish_reason": finish}]}
+                self._safe_write(f"data: {json.dumps(final)}\n\n".encode()
+                                 + b"data: [DONE]\n\n", aborted)
+                return
+            emit = (lambda d, f: timer.tick()) if timer is not None \
+                else (lambda d, f: None)
+            reply, n_comp, finish = state.sched_drain(
+                ticket, ids[-1], stop=stops, emit=emit)
+            if finish == "length":
+                finish = "stop"
+            if finish == "timeout":
+                state.metrics.bump("deadline_timeouts")
+            self._json(200, {
+                "id": cid, "object": "chat.completion", "created": created,
+                "model": state.model_name,
+                "choices": [{"index": 0, "finish_reason": finish,
+                             "message": {"role": "assistant",
+                                         "content": reply}}],
+                "usage": {"prompt_tokens": len(ids),
+                          "completion_tokens": n_comp,
+                          "total_tokens": len(ids) + n_comp}})
+
         def do_POST(self):
             self._begin_request()
             ppath, _, pquery = self.path.partition("?")
@@ -1372,25 +1752,52 @@ def make_handler(state: ApiState):
             # stream timer starts at admission: queue wait counts into TTFT
             timer = _StreamTimer()
             try:
-                # THE engine mutex: one generation at a time per KV cache;
-                # the wait here IS the admission queue try_enter bounded
-                q0 = time.perf_counter()
-                state.engine_lock.acquire()
-                q1 = time.perf_counter()
-                obs_metrics.QUEUE_WAIT.observe(q1 - q0)
-                obs_trace.record("queue_wait", q0, q1)
-                _log.info("queue", extra={"wait_s": round(q1 - q0, 6)})
-                try:
+                locked = False
+                use_sched = False
+                if self._sched_eligible(body):
+                    if self.path == "/v1/completions":
+                        use_sched = True
+                    else:
+                        # chat spillover: the mutex path keeps the
+                        # NaiveCache prefix-resume win while uncontended;
+                        # under contention the request joins a slot
+                        # instead of queueing on the mutex
+                        locked = state.engine_lock.acquire(blocking=False)
+                        use_sched = not locked
+                if use_sched:
+                    # slot path: no engine mutex — the scheduler
+                    # interleaves this request with whatever else is live
+                    # (its sched_admit span records the slot-queue wait)
                     state.mark_active(True)
                     try:
                         if self.path == "/v1/completions":
-                            self._completions(body, deadline, timer)
+                            self._completions_sched(body, deadline, timer)
                         else:
-                            self._chat(body, deadline, timer)
+                            self._chat_sched(body, deadline, timer)
                     finally:
                         state.mark_active(False)
-                finally:
-                    state.engine_lock.release()
+                else:
+                    # THE engine mutex: one generation at a time per KV
+                    # cache; the wait here IS the admission queue
+                    # try_enter bounded
+                    q0 = time.perf_counter()
+                    if not locked:
+                        state.engine_lock.acquire()
+                    q1 = time.perf_counter()
+                    obs_metrics.QUEUE_WAIT.observe(q1 - q0)
+                    obs_trace.record("queue_wait", q0, q1)
+                    _log.info("queue", extra={"wait_s": round(q1 - q0, 6)})
+                    try:
+                        state.mark_active(True)
+                        try:
+                            if self.path == "/v1/completions":
+                                self._completions(body, deadline, timer)
+                            else:
+                                self._chat(body, deadline, timer)
+                        finally:
+                            state.mark_active(False)
+                    finally:
+                        state.engine_lock.release()
                 state.metrics.bump("requests_served")
                 _log.info("finish", extra={
                     "path": self.path,
@@ -1457,7 +1864,7 @@ def make_handler(state: ApiState):
                     n_choices, n_prompt, n_completion = state.complete_n(
                         params, deadline=deadline)
                 except ContextOverflow as e:
-                    self._json(400, {"error": str(e)})
+                    self._json(400, state.overflow_body(e))
                     return
                 if any(fin == "timeout" for _, fin in n_choices):
                     state.metrics.bump("deadline_timeouts")
@@ -1632,6 +2039,7 @@ def main(argv=None):
                          "(sequence-sharded KV cache); drop one of them")
     engine, tok = load_stack(args)
     batch_engine = None
+    scheduler = None
     if args.batch_slots > 0:
         # share the chat engine's placed weights; only a new KV cache is
         # allocated (see ApiState docstring)
@@ -1641,6 +2049,23 @@ def main(argv=None):
                               step_timeout=args.step_timeout)
         _log.info("batch_serving_enabled",
                   extra={"slots": args.batch_slots})
+        try:
+            # tentpole: continuous batching — single-stream requests join
+            # the batch engine at decode-step granularity instead of
+            # serializing on the engine mutex (which stays the fallback
+            # path for seeded sampling, logprobs, echo, and n>1)
+            scheduler = SlotScheduler(
+                batch_engine, prefill_chunk=args.sched_prefill_chunk,
+                max_wait_ms=args.sched_max_wait_ms)
+            _log.info("slot_scheduler_enabled", extra={
+                "slots": args.batch_slots,
+                "prefill_chunk": args.sched_prefill_chunk,
+                "max_wait_ms": args.sched_max_wait_ms})
+        except ValueError as e:
+            # quantized KV / sp mesh: lockstep batch serving still works,
+            # only decode-step admission is off
+            _log.warning("slot_scheduler_disabled",
+                         extra={"reason": str(e)})
     state = ApiState(engine, tok, default_temperature=args.temperature,
                      default_topp=args.topp, chunk=args.chunk,
                      batch_engine=batch_engine,
@@ -1648,10 +2073,15 @@ def main(argv=None):
                      request_timeout=args.request_timeout,
                      io_timeout=args.io_timeout,
                      drain_grace=args.drain_grace,
-                     snapshot_dir=args.snapshot_dir)
+                     snapshot_dir=args.snapshot_dir,
+                     scheduler=scheduler)
     if args.snapshot_dir:
         state.restore_snapshot()
-    serve(state, host=args.host, port=args.port)
+    try:
+        serve(state, host=args.host, port=args.port)
+    finally:
+        if scheduler is not None:
+            scheduler.close()
 
 
 if __name__ == "__main__":

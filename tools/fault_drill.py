@@ -50,6 +50,13 @@ def post(base: str, body: dict, timeout: float = 240.0):
     return urllib.request.urlopen(req, timeout=timeout)
 
 
+def post_to(base: str, path: str, body: dict, timeout: float = 240.0):
+    req = urllib.request.Request(
+        base + path, json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
 def get(base: str, path: str) -> dict:
     with urllib.request.urlopen(base + path, timeout=30) as r:
         return json.loads(r.read())
@@ -318,6 +325,53 @@ def drill_latency_histogram(model, tok):
         s.stop()
 
 
+def drill_slot_churn(model, tok):
+    """--batch-slots 2 + a one-shot device fault → the poisoned dispatch
+    500s its request, the slot is freed, and two waves of more requests
+    than slots (churn over reused rows) all serve normally."""
+    s = Server(model, tok,
+               faults="engine.device_step=raise:RuntimeError:churnx1",
+               extra_flags=["--batch-slots", "2"])
+    try:
+        s.wait_ready()
+        h = get(s.base, "/health")
+        assert h["batch_slots"] == 2, h
+        assert h["scheduler"] and h["scheduler"]["slots"] == 2, h
+        comp = {"prompt": "hello", "max_tokens": 6}
+        # single-string /v1/completions rides the slot scheduler; the
+        # first dispatch eats the injected fault
+        try:
+            post_to(s.base, "/v1/completions", comp)
+            raise AssertionError("expected 500 from the poisoned dispatch")
+        except urllib.error.HTTPError as e:
+            assert e.code == 500, e.code
+        # churn: two waves of 4 requests over 2 slots — every row gets
+        # reused, including the one the fault just retired
+        results: list = []
+
+        def run():
+            with post_to(s.base, "/v1/completions", comp) as r:
+                results.append(json.loads(r.read()))
+
+        for _ in range(2):
+            ths = [threading.Thread(target=run) for _ in range(4)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join(240)
+        assert len(results) == 8, f"only {len(results)}/8 served"
+        for d in results:
+            assert d["choices"][0]["finish_reason"] in ("stop", "length"), d
+        occ = get(s.base, "/health")["scheduler"]
+        assert occ["active"] == 0 and occ["queued"] == 0, occ
+        retires = get(s.base, "/metrics")["sched_slot_retires"]
+        assert any(k.endswith("/error") for k in retires), retires
+        assert any(k.endswith("/length") or k.endswith("/stop")
+                   for k in retires), retires
+    finally:
+        s.stop()
+
+
 DRILLS = {
     "deadline": drill_deadline,
     "disconnect": drill_disconnect,
@@ -327,6 +381,7 @@ DRILLS = {
     "corruption": drill_corruption,
     "snapshot_restart": drill_snapshot_restart,
     "latency_histogram": drill_latency_histogram,
+    "slot_churn": drill_slot_churn,
 }
 
 
