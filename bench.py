@@ -532,6 +532,15 @@ def _bench_sched(cfg, slots=4, max_new=96):
     total = sum(counts)
     print(f"bench: sched {total} tokens over {slots} staggered requests "
           f"in {elapsed:.2f}s", file=sys.stderr)
+    # goodput decomposition (obs/flight.py SlotTimeline + scheduler
+    # accounting): where the wall time of the measured wave actually went
+    from dllama_tpu.obs import metrics as obs_metrics
+    comp = obs_metrics.SCHED_STEP_TIME_MS.json_value()
+    if comp:
+        split = " ".join(f"{k}={v:.0f}ms" for k, v in sorted(comp.items()))
+        print(f"bench: sched goodput "
+              f"{obs_metrics.SCHED_GOODPUT_RATIO.value:.3f} ({split})",
+              file=sys.stderr)
     return total / elapsed
 
 

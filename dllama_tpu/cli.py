@@ -30,6 +30,7 @@ mesh axes, capability the reference does not have.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -209,6 +210,18 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["debug", "info", "warning", "error"],
                    help="log verbosity for the dllama logger tree "
                         "(default: DLLAMA_LOG env, else info)")
+    p.add_argument("--trace-buffer", type=int, default=None,
+                   help="span ring capacity for /debug/trace (default "
+                        "DLLAMA_TRACE_BUFFER, else 8192)")
+    p.add_argument("--flight-buffer", type=int, default=None,
+                   help="flight-recorder ring capacity for /debug/requests "
+                        "(default DLLAMA_FLIGHT_BUFFER, else 512)")
+    p.add_argument("--slo", default=None, metavar="SPEC",
+                   help="declarative latency/error objectives, e.g. "
+                        "'ttft_p95=1500ms,itl_p99=120ms,error_rate=0.5%%'. "
+                        "Burn rates over rolling windows (DLLAMA_SLO_WINDOWS, "
+                        "default 5m,1h) feed slo_burn_rate gauges and the "
+                        "/health verdict.  Default: DLLAMA_SLO env")
     return p
 
 
@@ -255,6 +268,15 @@ def load_stack(args, batch: int | None = None) -> tuple[Engine, Tokenizer]:
 
 def _seed(args) -> int:
     return args.seed if args.seed is not None else int(time.time())
+
+
+def _print_slo_summary(args) -> None:
+    """End-of-run SLO verdict beside the dispatch summary (obs/slo.py);
+    silent unless the operator declared objectives (main() validates the
+    spec up front and stashes the engine)."""
+    slo = getattr(args, "_slo_engine", None)
+    if slo is not None:
+        print(slo.summary_line())
 
 
 def _encode_prompt(engine, tok, prompt: str) -> list[int]:
@@ -307,6 +329,7 @@ def cmd_inference(args) -> None:
     # number from an XLA-dequant fallback must not read as a clean result
     from .obs import dispatch as obs_dispatch
     print(obs_dispatch.summary_line())
+    _print_slo_summary(args)
     if engine.timing_mode == "host-fetch":
         # remote tunnel: the ready marker fires at dispatch, so I above is
         # the whole host-fetch wall (T≈0 by construction) — the xplane
@@ -414,6 +437,7 @@ def cmd_batch(args) -> None:
         print(f"Batched throughput:  {generated / dt:.2f} tok/s")
     from .obs import dispatch as obs_dispatch
     print(obs_dispatch.summary_line())
+    _print_slo_summary(args)
 
 
 def cmd_chat(args) -> None:
@@ -511,6 +535,18 @@ def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
     from .obs.log import configure as configure_logging
     configure_logging(args.log_format, args.log_level)
+    from .obs import flight as obs_flight, trace as obs_trace
+    obs_trace.configure(args.trace_buffer)
+    obs_flight.configure(args.flight_buffer)
+    # validate --slo up front (a bad spec must not surface only after a
+    # long run); the engine is consulted again by _print_slo_summary
+    spec = args.slo or os.environ.get("DLLAMA_SLO", "")
+    if spec:
+        from .obs.slo import SloEngine
+        try:
+            args._slo_engine = SloEngine.from_spec(spec)
+        except ValueError as e:
+            raise SystemExit(f"--slo: {e}")
     from .parallel.distributed import distributed_env, init_distributed
     if args.coordinator or distributed_env() is not None:
         init_distributed(args.coordinator, args.nproc, args.proc_id)

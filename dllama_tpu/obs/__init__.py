@@ -19,6 +19,13 @@ Three stdlib-only building blocks, threaded through every layer:
   dense), labeled degrade counters replacing the old warn-once prints,
   and the process-wide ``degraded`` flag that ``/health`` and the
   end-of-run CLI summary surface.
+* :mod:`.flight` — the request flight recorder (per-request lifecycle
+  records keyed by ``X-Request-Id``, served at ``/debug/requests``) and
+  the per-dispatch slot timeline behind ``/debug/timeline`` and the
+  scheduler goodput decomposition.
+* :mod:`.slo` — declarative latency/error objectives with rolling
+  multi-window burn rates (``--slo`` / ``DLLAMA_SLO``), feeding
+  ``slo_burn_rate`` gauges and the ``/health`` verdict.
 
 Nothing here imports jax (or anything beyond the stdlib): the engine,
 loaders, and server all import ``obs`` freely with no cycle risk, and a
@@ -27,4 +34,4 @@ metric bump on the decode hot path costs one small lock.
 
 from __future__ import annotations
 
-from . import dispatch, log, metrics, trace  # noqa: F401
+from . import dispatch, flight, log, metrics, slo, trace  # noqa: F401

@@ -147,9 +147,9 @@ class LabeledCounter:
         self.help = help
         self.labels = (labels,) if isinstance(labels, str) else tuple(labels)
         self._lock = threading.Lock()
-        self._children: dict[tuple, int] = {}
+        self._children: dict[tuple, float] = {}
 
-    def inc(self, *values, n: int = 1) -> None:
+    def inc(self, *values, n: float = 1) -> None:
         if len(values) != len(self.labels):
             raise ValueError(f"{self.name} takes {len(self.labels)} label "
                              f"value(s) {self.labels}, got {values!r}")
@@ -157,13 +157,13 @@ class LabeledCounter:
         with self._lock:
             self._children[key] = self._children.get(key, 0) + n
 
-    def get(self, *values) -> int:
+    def get(self, *values):
         key = tuple(str(v) for v in values)
         with self._lock:
             return self._children.get(key, 0)
 
     @property
-    def total(self) -> int:
+    def total(self):
         with self._lock:
             return sum(self._children.values())
 
@@ -175,7 +175,8 @@ class LabeledCounter:
 
     def json_value(self):
         with self._lock:
-            return {"/".join(k): v for k, v in sorted(self._children.items())}
+            return {"/".join(k): (v if isinstance(v, int) else round(v, 6))
+                    for k, v in sorted(self._children.items())}
 
     def render(self, lines: list[str]) -> None:
         if self.help:
@@ -185,11 +186,12 @@ class LabeledCounter:
             items = sorted(self._children.items())
         for values, count in items:
             lbl = ",".join(f'{l}="{v}"' for l, v in zip(self.labels, values))
-            lines.append(f"{self.name}{{{lbl}}} {count}")
+            lines.append(f"{self.name}{{{lbl}}} {_fmt(count)}")
 
 
 class LabeledGauge:
-    """A gauge family (one sample per label value).  ``fn`` — when set —
+    """A gauge family (one sample per label-value combination; ``label``
+    may be a single label name or a tuple of names).  ``fn`` — when set —
     computes the whole family at read time as a ``{label_value: number}``
     dict (e.g. per-device HBM stats queried at scrape); an empty dict
     means the backend has no data and the family renders no samples
@@ -197,28 +199,53 @@ class LabeledGauge:
 
     kind = "gauge"
 
-    def __init__(self, name: str, json_key: str, label: str, help: str = "",
+    def __init__(self, name: str, json_key: str, label, help: str = "",
                  fn=None):
         self.name = name
         self.json_key = json_key
-        self.label = label
+        self.labels = (label,) if isinstance(label, str) else tuple(label)
         self.help = help
         self.fn = fn
         self._lock = threading.Lock()
-        self._values: dict[str, float] = {}
+        self._values: dict[tuple, float] = {}
 
-    def set(self, label_value, v: float) -> None:
+    @property
+    def label(self) -> str:  # back-compat for single-label callers
+        return self.labels[0]
+
+    def set(self, *args) -> None:
+        """``set(label_value, ..., v)`` — the last positional is the value,
+        everything before it is one value per label."""
+        *values, v = args
+        if len(values) != len(self.labels):
+            raise ValueError(f"{self.name} takes {len(self.labels)} label "
+                             f"value(s) {self.labels}, got {values!r}")
+        key = tuple(str(x) for x in values)
         with self._lock:
-            self._values[str(label_value)] = float(v)
+            self._values[key] = float(v)
 
-    def values(self) -> dict[str, float]:
+    def get(self, *values) -> float:
+        key = tuple(str(x) for x in values)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def _items(self) -> dict[tuple, float]:
         if self.fn is not None:
             try:
-                return {str(k): float(v) for k, v in (self.fn() or {}).items()}
+                return {(str(k),) if not isinstance(k, tuple)
+                        else tuple(str(x) for x in k): float(v)
+                        for k, v in (self.fn() or {}).items()}
             except Exception:
                 return {}
         with self._lock:
             return dict(self._values)
+
+    def values(self) -> dict:
+        """Single-label families keep their historical flat-string keys;
+        multi-label families join label values with ``/``."""
+        if len(self.labels) == 1:
+            return {k[0]: v for k, v in self._items().items()}
+        return {"/".join(k): v for k, v in self._items().items()}
 
     def reset(self) -> None:
         with self._lock:
@@ -231,8 +258,9 @@ class LabeledGauge:
         if self.help:
             lines.append(f"# HELP {self.name} {_escape_help(self.help)}")
         lines.append(f"# TYPE {self.name} gauge")
-        for k, v in sorted(self.values().items()):
-            lines.append(f'{self.name}{{{self.label}="{k}"}} {_fmt(v)}')
+        for k, v in sorted(self._items().items()):
+            lbl = ",".join(f'{l}="{x}"' for l, x in zip(self.labels, k))
+            lines.append(f"{self.name}{{{lbl}}} {_fmt(v)}")
 
 
 class Histogram:
@@ -359,7 +387,7 @@ class Registry:
         return self._register(LabeledCounter, json_key, name, (labels, help),
                               {})
 
-    def labeled_gauge(self, json_key: str, label: str, help: str = "",
+    def labeled_gauge(self, json_key: str, label, help: str = "",
                       name: str | None = None, fn=None) -> LabeledGauge:
         g = self._register(LabeledGauge, json_key, name, (label, help), {})
         if fn is not None:
@@ -551,3 +579,37 @@ HBM_BYTES_IN_USE = REGISTRY.labeled_gauge(
 HBM_BYTES_PEAK = REGISTRY.labeled_gauge(
     "hbm_bytes_peak", "device",
     "Per-device peak HBM bytes allocated since process start.")
+
+# scheduler goodput accounting (runtime/scheduler.py + obs/flight.py):
+# every millisecond between the scheduler's first and last dispatch lands
+# in exactly one component, so the family sums to the measured wall time.
+# prefill/decode/pad split each dispatch by row occupancy; host_gap is
+# un-slept time between dispatches (token fanout, admission, array prep);
+# idle is time slept waiting for work.
+HOST_GAP_MS_BUCKETS = (0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100,
+                       250, 1000)
+SCHED_STEP_TIME_MS = REGISTRY.labeled_counter(
+    "sched_step_time_ms", ("component",),
+    "Scheduler wall-time decomposition in milliseconds, by component "
+    "(prefill|decode|pad|host_gap|idle).")
+SCHED_GOODPUT_RATIO = REGISTRY.gauge(
+    "sched_goodput_ratio",
+    "Fraction of scheduler wall time spent on live rows "
+    "((prefill+decode) / all components), cumulative since start.")
+SCHED_HOST_GAP_MS = REGISTRY.histogram(
+    "sched_host_gap_ms", HOST_GAP_MS_BUCKETS,
+    "Host-side gap between consecutive scheduler dispatches (ms), "
+    "excluding idle sleep — the dispatch overhead ROADMAP item 3 "
+    "(on-device multi-step decode) would amortize.")
+
+# SLO burn-rate engine (obs/slo.py): burn = observed bad fraction over a
+# rolling window / allowed bad fraction; >= 1.0 means the error budget is
+# burning faster than the objective permits.
+SLO_BURN_RATE = REGISTRY.labeled_gauge(
+    "slo_burn_rate", ("objective", "window"),
+    "Error-budget burn rate per objective and rolling window "
+    "(>= 1.0 means the budget is being spent faster than allowed).")
+SLO_VIOLATIONS = REGISTRY.labeled_counter(
+    "slo_violations", ("objective",),
+    "Transitions of an objective into the violating state (all windows "
+    "burning >= 1.0) since process start.")

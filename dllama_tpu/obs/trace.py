@@ -11,8 +11,10 @@ heavyweight XLA-level tool.
 
 Timestamps are ``time.perf_counter()`` seconds (converted to µs in the
 export); they order and measure correctly within one process but are not
-wall-clock.  Capacity comes from ``DLLAMA_TRACE_CAPACITY`` (default
-8192 spans ≈ a few hundred requests).
+wall-clock.  Capacity comes from ``--trace-buffer`` /
+``DLLAMA_TRACE_BUFFER`` (legacy alias ``DLLAMA_TRACE_CAPACITY``;
+default 8192 spans ≈ a few hundred requests); a malformed value warns
+once and falls back, mirroring the ``DLLAMA_Q40_BLOCK_TILES`` contract.
 """
 
 from __future__ import annotations
@@ -23,16 +25,42 @@ import time
 from collections import deque
 from contextlib import contextmanager
 
-from .log import request_id_var
+from .log import get_logger, request_id_var
+
+_log = get_logger("obs.trace")
 
 DEFAULT_CAPACITY = 8192
 
+_warned_specs: set = set()
+
+
+def parse_buffer_env(var: str, default: int, legacy: str | None = None) -> int:
+    """Ring capacity from ``var`` (falling back to ``legacy``); a value
+    that is not a positive integer logs one warning per distinct spec and
+    falls back to ``default`` — never raises (the buffer size must not be
+    able to take the server down)."""
+    spec = os.environ.get(var)
+    if spec is None and legacy is not None:
+        spec = os.environ.get(legacy)
+    if spec is None or spec == "":
+        return default
+    try:
+        cap = int(spec)
+        if cap < 1:
+            raise ValueError(spec)
+        return cap
+    except ValueError:
+        key = (var, spec)
+        if key not in _warned_specs:
+            _warned_specs.add(key)
+            _log.warning("%s=%r is not a positive integer; using default %d",
+                         var, spec, default)
+        return default
+
 
 def _capacity() -> int:
-    try:
-        return max(1, int(os.environ.get("DLLAMA_TRACE_CAPACITY", "")))
-    except ValueError:
-        return DEFAULT_CAPACITY
+    return parse_buffer_env("DLLAMA_TRACE_BUFFER", DEFAULT_CAPACITY,
+                            legacy="DLLAMA_TRACE_CAPACITY")
 
 
 class Tracer:
@@ -42,14 +70,28 @@ class Tracer:
         self._lock = threading.Lock()
         self._spans = deque(maxlen=capacity or _capacity())
 
-    def record(self, name: str, t0: float, t1: float, **args) -> None:
-        """Record a completed span; ``t0``/``t1`` are perf_counter secs."""
+    def record(self, name: str, t0: float, t1: float, rid=None,
+               **args) -> None:
+        """Record a completed span; ``t0``/``t1`` are perf_counter secs.
+        ``rid`` overrides the ambient contextvar request ID — threads that
+        work on behalf of another request (the scheduler loop) stamp the
+        ticket's ID explicitly."""
         th = threading.current_thread()
         span = {"name": name, "ts": t0, "dur": max(t1 - t0, 0.0),
                 "tid": th.ident or 0, "thread": th.name,
-                "rid": request_id_var.get(), "args": args}
+                "rid": rid if rid is not None else request_id_var.get(),
+                "args": args}
         with self._lock:
             self._spans.append(span)
+
+    def resize(self, capacity: int) -> None:
+        """Re-bound the ring, keeping the most recent spans that fit."""
+        with self._lock:
+            self._spans = deque(self._spans, maxlen=max(1, int(capacity)))
+
+    @property
+    def capacity(self) -> int:
+        return self._spans.maxlen or 0
 
     @contextmanager
     def span(self, name: str, **args):
@@ -110,8 +152,14 @@ class Tracer:
 TRACER = Tracer()
 
 
-def record(name: str, t0: float, t1: float, **args) -> None:
-    TRACER.record(name, t0, t1, **args)
+def record(name: str, t0: float, t1: float, rid=None, **args) -> None:
+    TRACER.record(name, t0, t1, rid=rid, **args)
+
+
+def configure(capacity: int | None = None) -> None:
+    """Apply a CLI-chosen capacity (``--trace-buffer``) after import."""
+    if capacity is not None:
+        TRACER.resize(capacity)
 
 
 def span(name: str, **args):

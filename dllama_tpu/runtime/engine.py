@@ -1038,6 +1038,9 @@ class Engine:
         t1 = time.perf_counter()
         if fresh:  # first call blocks through trace + compile
             obs_metrics.ENGINE_COMPILE_S.observe(t1 - t0)
+        # device share of the dispatch, read by the scheduler's slot
+        # timeline (obs/flight.py) to split wall into device vs host
+        self.last_slot_dispatch_ms = (t1 - t0) * 1e3
         obs_trace.record("slot_step", t0, t1, t=t, steps=steps)
         return np.asarray(toks_dev)  # (steps, B)
 

@@ -53,8 +53,9 @@ from collections import deque
 
 import numpy as np
 
+from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics, trace as obs_trace
-from ..obs.log import get_logger
+from ..obs.log import get_logger, new_request_id, request_id_var
 
 _log = get_logger("runtime.scheduler")
 
@@ -87,6 +88,10 @@ class Ticket:
         self.error: BaseException | None = None
         self.slot: int | None = None
         self.submitted_at = time.monotonic()
+        # the submitting thread's X-Request-Id rides the ticket onto the
+        # scheduler thread, where the contextvar is not set — spans, logs
+        # and the flight record all stamp this one grep-able ID
+        self.rid: str = request_id_var.get() or new_request_id()
         self._q: queue.SimpleQueue = queue.SimpleQueue()
         self._cancel: str | None = None
         self._on_cancel = None  # scheduler wakeup, bound at submit
@@ -149,6 +154,13 @@ class SlotScheduler:
         self._idle = threading.Event()  # set while paused with empty slots
         self._paused = 0
         self._step_ms_ema: float | None = None
+        # goodput accounting: every ms between the first and the latest
+        # dispatch lands in exactly one component (see obs/metrics.py)
+        self._first_dispatch_at: float | None = None   # perf_counter
+        self._last_dispatch_end: float | None = None   # perf_counter
+        self._idle_accum = 0.0     # seconds slept in _cond.wait since last dispatch
+        self._comp = {"prefill": 0.0, "decode": 0.0, "pad": 0.0,
+                      "host_gap": 0.0, "idle": 0.0}
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="dllama-slot-scheduler")
         self._thread.start()
@@ -182,6 +194,8 @@ class SlotScheduler:
             t._on_cancel = self._wake
             self._queue.append(t)
             self._cond.notify_all()
+        obs_flight.submit(t.rid, n_prompt=len(t.prompt), max_new=t.max_new,
+                          temperature=t.temperature, source="scheduler")
         return t
 
     def occupancy(self) -> dict:
@@ -250,16 +264,26 @@ class SlotScheduler:
         s.ticket = None
         obs_metrics.SCHED_SLOT_RETIRES.inc(slot_idx, reason)
         now = time.monotonic()
-        obs_trace.record("sched_retire", now, now, slot=slot_idx,
+        obs_trace.record("sched_retire", now, now, rid=t.rid, slot=slot_idx,
                          reason=reason, produced=s.produced)
-        _log.info("slot retire", extra={
-            "slot": slot_idx, "reason": reason, "produced": s.produced})
+        # the log record factory stamps the contextvar, so bind the
+        # ticket's ID around the call (this thread serves many requests)
+        ctx = request_id_var.set(t.rid)
+        try:
+            _log.info("slot retire", extra={
+                "slot": slot_idx, "reason": reason, "produced": s.produced})
+        finally:
+            request_id_var.reset(ctx)
+        obs_flight.retire(t.rid, reason, produced=s.produced, pos=s.pos,
+                          error=repr(error) if error is not None else None)
         t._q.put(_DONE)
 
     def _fail_ticket(self, t: Ticket, reason: str,
                      error: BaseException | None = None) -> None:
         t.finish = reason
         t.error = error
+        obs_flight.retire(t.rid, reason, produced=0,
+                          error=repr(error) if error is not None else None)
         t._q.put(_DONE)
 
     def _admit_locked(self, now: float) -> None:
@@ -280,16 +304,47 @@ class SlotScheduler:
             s.produced = 0
             s.last = 0
             t.slot = i
+            queued_ms = round((now - t.submitted_at) * 1e3, 3)
             obs_metrics.SCHED_SLOT_JOINS.inc(i)
-            obs_trace.record("sched_admit", t.submitted_at, now, slot=i,
-                             queued_ms=round((now - t.submitted_at) * 1e3, 3),
+            obs_trace.record("sched_admit", t.submitted_at, now, rid=t.rid,
+                             slot=i, queued_ms=queued_ms,
                              n_prompt=len(t.prompt))
-            _log.info("slot join", extra={
-                "slot": i, "n_prompt": len(t.prompt),
-                "queued_ms": round((now - t.submitted_at) * 1e3, 3)})
+            ctx = request_id_var.set(t.rid)
+            try:
+                _log.info("slot join", extra={
+                    "slot": i, "n_prompt": len(t.prompt),
+                    "queued_ms": queued_ms})
+            finally:
+                request_id_var.reset(ctx)
+            obs_flight.admit(t.rid, slot=i, queued_ms=queued_ms)
+            obs_metrics.QUEUE_WAIT.observe(max(now - t.submitted_at, 0.0))
 
     def _active(self) -> list[int]:
         return [i for i, s in enumerate(self.slots) if s.ticket is not None]
+
+    def _account(self, component: str, ms: float) -> None:
+        self._comp[component] += ms
+        obs_metrics.SCHED_STEP_TIME_MS.inc(component, n=ms)
+
+    def _slot_entries(self, active, prefset, rid_by_slot, emitted) -> list:
+        out = []
+        for i in range(len(self.slots)):
+            if i in rid_by_slot:
+                out.append({"slot": i,
+                            "phase": "prefill" if i in prefset else "decode",
+                            "tokens": emitted.get(i, 0),
+                            "request_id": rid_by_slot[i]})
+            else:
+                out.append({"slot": i, "phase": "pad", "tokens": 0})
+        return out
+
+    def wall_window(self) -> tuple[float, float] | None:
+        """``perf_counter`` bounds of the accounted span (first dispatch
+        start → latest dispatch end); the goodput components sum to this
+        interval by construction.  None before the first dispatch."""
+        if self._first_dispatch_at is None or self._last_dispatch_end is None:
+            return None
+        return self._first_dispatch_at, self._last_dispatch_end
 
     def _run(self) -> None:
         try:
@@ -320,8 +375,13 @@ class SlotScheduler:
                         if self._paused:
                             self._idle.set()
                         # parked: submissions/cancels/close notify; the
-                        # short timeout re-checks queued deadlines
+                        # short timeout re-checks queued deadlines.  The
+                        # slept time is "idle" in the goodput decomposition
+                        # (the remainder of an inter-dispatch gap is
+                        # host_gap — true scheduling overhead)
+                        w0 = time.perf_counter()
                         self._cond.wait(0.1)
+                        self._idle_accum += time.perf_counter() - w0
                         continue
                 self._dispatch(active, queued)
         except BaseException as e:  # loop must not die silently
@@ -342,6 +402,12 @@ class SlotScheduler:
         prefilling = [i for i in active
                       if slots[i].fed < len(slots[i].ticket.prompt)]
         room = min(eng.seq_len - slots[i].pos for i in active)
+        # both dispatch dimensions ride the compile key (engine.slot_step
+        # caches per (T, steps, greedy)), so each is rounded down to a
+        # power of two: transient values — a neighbor 3 tokens from its
+        # prompt end, a row 2 tokens from its budget — would otherwise
+        # mint one-off executables (PR-4 compile telemetry made that
+        # visible).  O(log chunk × log burst) shapes total, each reusable.
         if prefilling:
             # mixed step: prefill chunks ride along with the decode rows'
             # single tokens; steps=1 keeps every row's clock advancing by
@@ -349,19 +415,23 @@ class SlotScheduler:
             t_width = min(self.prefill_chunk, room,
                           max(len(slots[i].ticket.prompt) - slots[i].fed
                               for i in prefilling))
+            t_width = 1 << (t_width.bit_length() - 1)
             steps = 1
         else:
             # pure decode: burst on device, clamped so (a) no row outruns
-            # its budget/window and (b) queued work waits at most
-            # ~max_wait_ms for the next admission boundary
+            # the context edge and (b) queued work waits at most
+            # ~max_wait_ms for the next admission boundary.  A row that
+            # hits its token budget mid-burst retires and the fanout
+            # discards its overrun — cheaper than letting per-row budget
+            # minima pick the burst size (lockstep rows share the cost of
+            # the longest-running neighbor either way)
             t_width = 1
-            steps = min(self.decode_burst, room,
-                        min(slots[i].ticket.max_new - slots[i].produced
-                            for i in active))
+            steps = min(self.decode_burst, room)
             if queued and self._step_ms_ema:
                 steps = min(steps, max(
                     1, int(self.max_wait_ms / self._step_ms_ema)))
             steps = max(1, steps)
+            steps = 1 << (steps.bit_length() - 1)
 
         tokens = np.zeros((b, t_width), np.int32)
         n_valid = np.ones((b,), np.int32)
@@ -381,26 +451,69 @@ class SlotScheduler:
                 tokens[i, 0] = s.last
 
         obs_metrics.SCHED_BATCH_EFFICIENCY.set(len(active) / b)
+        prefset = set(prefilling)
+        rid_by_slot = {i: slots[i].ticket.rid for i in active}
+        fed_by_slot = {i: int(n_valid[i]) for i in prefilling}
+
+        # inter-dispatch gap: idle (slept waiting for work) vs host_gap
+        # (token fanout, admission, array prep — the overhead ROADMAP
+        # item 3's on-device burst would amortize)
+        tp0 = time.perf_counter()
+        host_gap_ms = idle_ms = 0.0
+        if self._last_dispatch_end is None:
+            self._first_dispatch_at = tp0
+        else:
+            gap_ms = max(tp0 - self._last_dispatch_end, 0.0) * 1e3
+            idle_ms = min(self._idle_accum * 1e3, gap_ms)
+            host_gap_ms = gap_ms - idle_ms
+            self._account("idle", idle_ms)
+            self._account("host_gap", host_gap_ms)
+            obs_metrics.SCHED_HOST_GAP_MS.observe(host_gap_ms)
+        self._idle_accum = 0.0
+
         t0 = time.monotonic()
+        error = None
         try:
             out = eng.slot_step(tokens, pos_rows, n_valid,
                                 temps_np=temps, topps_np=topps, steps=steps)
         except Exception as e:
+            error = e
+        tp1 = time.perf_counter()
+        self._last_dispatch_end = tp1
+        wall_ms = (tp1 - tp0) * 1e3
+        # split the dispatch wall by row occupancy: every row rode the
+        # same lockstep step, so a row's share IS wall * rows/b
+        n_pref, n_act = len(prefilling), len(active)
+        self._account("prefill", wall_ms * n_pref / b)
+        self._account("decode", wall_ms * (n_act - n_pref) / b)
+        self._account("pad", wall_ms * (b - n_act) / b)
+        busy = self._comp["prefill"] + self._comp["decode"]
+        total = sum(self._comp.values())
+        if total > 0:
+            obs_metrics.SCHED_GOODPUT_RATIO.set(busy / total)
+
+        if error is not None:
             # a failed dispatch poisons at most this step: retire every
             # active slot with the error and keep serving — stale cache
             # garbage sits above future occupants' causal ceilings
-            _log.error("slot dispatch failed", extra={"error": repr(e)})
+            _log.error("slot dispatch failed", extra={"error": repr(error)})
+            obs_flight.TIMELINE.record_step(
+                ts=tp0, wall_ms=wall_ms, host_gap_ms=host_gap_ms,
+                idle_ms=idle_ms, steps=steps, t_width=t_width, error=True,
+                slots=self._slot_entries(active, prefset, rid_by_slot, {}))
             with self._cond:
                 for i in self._active():
-                    self._retire(i, "error", error=e)
+                    self._retire(i, "error", error=error)
             return
-        step_ms = (time.monotonic() - t0) * 1e3 / steps
+        step_ms = wall_ms / steps
         self._step_ms_ema = step_ms if self._step_ms_ema is None \
             else 0.8 * self._step_ms_ema + 0.2 * step_ms
         obs_trace.record("sched_step", t0, time.monotonic(),
                          active=len(active), queued=queued,
-                         t=t_width, steps=steps)
+                         t=t_width, steps=steps,
+                         rids=sorted(rid_by_slot.values()))
 
+        emitted = dict.fromkeys(active, 0)
         for j in range(steps):
             for i in active:
                 s = slots[i]
@@ -423,7 +536,31 @@ class SlotScheduler:
                         self._retire(i, "stop")
                     continue
                 s.produced += 1
+                emitted[i] += 1
                 t._q.put(tok)
                 if s.produced >= t.max_new or s.pos >= eng.seq_len:
                     with self._cond:
                         self._retire(i, "length")
+
+        # flight phases + timeline entry for this dispatch (after the
+        # fanout so the emitted-token counts are final; a row retired
+        # mid-burst still gets its last burst recorded)
+        for i in active:
+            rid = rid_by_slot[i]
+            if i in prefset:
+                # a completing chunk also emits the first sampled token —
+                # recorded as ``emitted`` on the chunk, not a zero-wall
+                # synthetic burst
+                obs_flight.phase(rid, "prefill_chunk",
+                                 tokens=fed_by_slot[i], ms=wall_ms,
+                                 pos=int(pos_rows[i]), emitted=emitted[i])
+            else:
+                obs_flight.phase(rid, "decode_burst", steps=steps,
+                                 tokens=emitted[i], wall_ms=wall_ms,
+                                 step_ms=step_ms)
+        obs_flight.TIMELINE.record_step(
+            ts=tp0, wall_ms=wall_ms,
+            device_ms=getattr(eng, "last_slot_dispatch_ms", None),
+            host_gap_ms=host_gap_ms, idle_ms=idle_ms, steps=steps,
+            t_width=t_width,
+            slots=self._slot_entries(active, prefset, rid_by_slot, emitted))

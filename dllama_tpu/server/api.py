@@ -71,8 +71,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs
 
 from ..io.integrity import ArtifactError
-from ..obs import dispatch as obs_dispatch, metrics as obs_metrics, \
-    trace as obs_trace
+from ..obs import dispatch as obs_dispatch, flight as obs_flight, \
+    metrics as obs_metrics, trace as obs_trace
 from ..obs.log import (configure as configure_logging, get_logger,
                        new_request_id, set_request_id)
 from ..runtime.engine import ContextOverflow, Engine, NumericFault, StepTimeout
@@ -257,18 +257,27 @@ class _StreamTimer:
     TTFT, matching what the client experiences) and ticked after each
     delta has been *flushed to the socket* — a slow emit path (e.g. an
     injected ``server.emit_delta`` delay) therefore lands in the first
-    delta's TTFT bucket, not between buckets."""
+    delta's TTFT bucket, not between buckets.
 
-    def __init__(self):
+    The exact observed values also feed the request's flight record
+    (obs/flight.py), so ``/debug/requests/<id>`` and the TTFT/ITL
+    histograms agree by construction."""
+
+    def __init__(self, rid=None):
         self.t0 = time.monotonic()
+        self.rid = rid
         self._last: float | None = None
 
     def tick(self) -> None:
         now = time.monotonic()
         if self._last is None:
-            obs_metrics.TTFT.observe(now - self.t0)
+            ttft = now - self.t0
+            obs_metrics.TTFT.observe(ttft)
+            obs_flight.first_token(self.rid, ttft)
         else:
-            obs_metrics.INTER_TOKEN.observe(now - self._last)
+            gap = now - self._last
+            obs_metrics.INTER_TOKEN.observe(gap)
+            obs_flight.inter_token(self.rid, gap)
         self._last = now
 
 
@@ -320,11 +329,13 @@ class ApiState:
                  max_pending: int = 8, request_timeout: float = 0.0,
                  io_timeout: float = 15.0, drain_grace: float = 30.0,
                  snapshot_dir: str | None = None,
-                 scheduler: SlotScheduler | None = None):
+                 scheduler: SlotScheduler | None = None,
+                 slo=None):
         self.engine = engine
         self.snapshot_dir = snapshot_dir
         self.batch_engine = batch_engine
         self.scheduler = scheduler
+        self.slo = slo  # obs.slo.SloEngine or None (--slo / DLLAMA_SLO)
         self.tokenizer = tokenizer
         self.default_temperature = default_temperature
         self.default_topp = default_topp
@@ -518,6 +529,10 @@ class ApiState:
             # one scrollback warning at load time
             "degraded": obs_dispatch.degraded(),
             "degrade_reasons": obs_dispatch.reasons(),
+            # SLO verdict (obs/slo.py): ok / at_risk / violating per
+            # objective plus the burn rates behind the call — evaluated
+            # live, so the health probe IS the alerting primitive
+            "slo": self.slo.evaluate() if self.slo is not None else None,
         }
 
     # ------------------------------------------------------------------
@@ -591,6 +606,12 @@ class ApiState:
             self.naive_cache.push(engine.pos, ChatMessage("assistant", reply))
         finish = "aborted" if flag.get("aborted") \
             else "timeout" if flag.get("timed_out") else "stop"
+        # coarse flight phases for the mutex path (the scheduler path
+        # records per-dispatch detail instead); rid rides the contextvar
+        obs_flight.phase(None, "prefill_chunk",
+                         tokens=len(prompt_tokens), pos=start_pos)
+        obs_flight.phase(None, "decode_burst", tokens=n_completion)
+        obs_flight.retire(None, finish, produced=n_completion)
         return reply, len(prompt_tokens), n_completion, finish
 
     # ------------------------------------------------------------------
@@ -1396,6 +1417,40 @@ def make_handler(state: ApiState):
                 except ValueError:
                     last = 20
                 self._json(200, obs_trace.trace_json(last))
+            elif path == "/debug/requests":
+                # flight recorder (obs/flight.py): newest-first summaries
+                try:
+                    n = int(q[0]) if (q := parse_qs(query).get("n")) else 50
+                except ValueError:
+                    n = 50
+                self._json(200, {"requests": obs_flight.recent(n)})
+            elif path.startswith("/debug/requests/"):
+                rid = path[len("/debug/requests/"):]
+                rec = obs_flight.get(rid)
+                if rec is None:
+                    self._json(404, {"error": f"no flight record for "
+                                              f"request id {rid!r}"})
+                else:
+                    self._json(200, rec)
+            elif path == "/debug/timeline":
+                # slot timeline + goodput decomposition (obs/flight.py +
+                # scheduler accounting); trace_dump.py --slots renders it
+                try:
+                    n = int(q[0]) if (q := parse_qs(query).get("n")) \
+                        else 256
+                except ValueError:
+                    n = 256
+                self._json(200, {
+                    "slots": (state.scheduler.engine.batch
+                              if state.scheduler is not None else 0),
+                    "steps": obs_flight.TIMELINE.snapshot(n),
+                    "components_ms":
+                        obs_metrics.SCHED_STEP_TIME_MS.json_value(),
+                    "goodput_ratio":
+                        obs_metrics.SCHED_GOODPUT_RATIO.json_value(),
+                    "host_gap_ms":
+                        obs_metrics.SCHED_HOST_GAP_MS.json_value(),
+                })
             else:
                 self._json(404, {"error": "not found"})
 
@@ -1750,7 +1805,11 @@ def make_handler(state: ApiState):
             tp0 = time.perf_counter()
             deadline = state.request_deadline(body)
             # stream timer starts at admission: queue wait counts into TTFT
-            timer = _StreamTimer()
+            timer = _StreamTimer(rid=self._rid)
+            # flight record opens at admission; the scheduler path merges
+            # its per-dispatch detail into this same record by request ID
+            obs_flight.submit(self._rid, path=self.path)
+            ok = False
             try:
                 locked = False
                 use_sched = False
@@ -1786,6 +1845,7 @@ def make_handler(state: ApiState):
                     q1 = time.perf_counter()
                     obs_metrics.QUEUE_WAIT.observe(q1 - q0)
                     obs_trace.record("queue_wait", q0, q1)
+                    obs_flight.admit(self._rid, queued_ms=(q1 - q0) * 1e3)
                     _log.info("queue", extra={"wait_s": round(q1 - q0, 6)})
                     try:
                         state.mark_active(True)
@@ -1799,6 +1859,7 @@ def make_handler(state: ApiState):
                     finally:
                         state.engine_lock.release()
                 state.metrics.bump("requests_served")
+                ok = True
                 _log.info("finish", extra={
                     "path": self.path,
                     "duration_s": round(time.monotonic() - t0, 6)})
@@ -1834,6 +1895,9 @@ def make_handler(state: ApiState):
                 state.leave(time.monotonic() - t0)
                 obs_trace.record("request", tp0, time.perf_counter(),
                                  path=self.path)
+                # fallback close for any path that didn't retire with a
+                # specific finish (no-op when one already did)
+                obs_flight.retire(self._rid, "served" if ok else "error")
 
         def _chat(self, body: dict, deadline: float | None,
                   timer: _StreamTimer | None = None):
@@ -2029,6 +2093,19 @@ def main(argv=None):
     # reuse the dllama flag surface; the server has no positional mode
     args = build_parser().parse_args(["inference", *argv])
     configure_logging(args.log_format, args.log_level)
+    obs_trace.configure(args.trace_buffer)
+    obs_flight.configure(args.flight_buffer)
+    slo = None
+    slo_spec = args.slo or os.environ.get("DLLAMA_SLO", "")
+    if slo_spec:
+        from ..obs.slo import SloEngine
+        try:
+            slo = SloEngine.from_spec(slo_spec)
+        except ValueError as e:
+            raise SystemExit(f"--slo: {e}")
+        _log.info("slo_enabled", extra={
+            "spec": slo.spec_display,
+            "windows": [w for w, _ in slo.windows]})
     if args.batch_slots > 0 and args.sp > 1:
         # the batch engine's ragged prefill needs the whole sequence axis
         # per shard (engine.prefill_ragged); accepting the flag would make
@@ -2074,7 +2151,8 @@ def main(argv=None):
                      io_timeout=args.io_timeout,
                      drain_grace=args.drain_grace,
                      snapshot_dir=args.snapshot_dir,
-                     scheduler=scheduler)
+                     scheduler=scheduler,
+                     slo=slo)
     if args.snapshot_dir:
         state.restore_snapshot()
     try:
@@ -2082,6 +2160,10 @@ def main(argv=None):
     finally:
         if scheduler is not None:
             scheduler.close()
+        if slo is not None:
+            # end-of-run verdict next to the dispatch summary, same as the
+            # CLI modes (cli._print_slo_summary)
+            print(slo.summary_line())
 
 
 if __name__ == "__main__":

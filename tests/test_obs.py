@@ -497,3 +497,25 @@ def test_trace_dump_cli(api, tmp_path, capsys):
     assert "spans across" in printed
     # unreachable server → clean failure, not a traceback
     assert tool.main(["http://127.0.0.1:1", "-o", str(out)]) == 1
+
+
+def test_metric_catalog_matches_docs():
+    """Doc-drift guard (PR-7 satellite): every module-level metric family
+    in obs/metrics.py has a row in the docs/OBSERVABILITY.md catalog, and
+    every catalog row names a real family — both directions.  Ad-hoc
+    metrics registered by tests don't count (module attributes only);
+    dllama_uptime_seconds is rendered inline by the registry."""
+    from dllama_tpu.obs.metrics import LabeledCounter, LabeledGauge
+    code = {"dllama_uptime_seconds"}
+    for obj in vars(obs_metrics).values():
+        if isinstance(obj, (Counter, Gauge, Histogram,
+                            LabeledCounter, LabeledGauge)):
+            code.add(obj.name)
+    with open(os.path.join(REPO, "docs", "OBSERVABILITY.md"),
+              encoding="utf-8") as f:
+        text = f.read()
+    documented = set(re.findall(r"^\| `(dllama_[a-z0-9_]+)", text, re.M))
+    assert code <= documented, \
+        f"metric families missing a catalog row: {sorted(code - documented)}"
+    assert documented <= code, \
+        f"catalog rows naming no metric family: {sorted(documented - code)}"

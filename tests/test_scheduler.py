@@ -23,6 +23,7 @@ The tentpole contracts, each pinned here on CPU with a tiny model:
   on CPU is noise; the dispatch count is what the scheduler amortizes).
 """
 
+import logging
 import threading
 import time
 
@@ -31,6 +32,7 @@ import pytest
 
 from dllama_tpu.models.config import tiny_config
 from dllama_tpu.models.params import init_params
+from dllama_tpu.obs import flight as obs_flight, trace as obs_trace
 from dllama_tpu.parallel.mesh import make_mesh
 from dllama_tpu.runtime.engine import Engine
 from dllama_tpu.runtime.faults import FAULTS, injected
@@ -272,3 +274,84 @@ def test_aggregate_throughput_beats_serialized_2x(sched_stack):
         FAULTS.clear()
     # equal token totals, so the tok/s ratio is the inverse duration ratio
     assert serial_s >= 2.0 * sched_s, (serial_s, sched_s)
+
+
+class _Capture(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+def test_request_id_stamped_in_spans_and_logs(solo_refs, sched_stack):
+    """PR-7 satellite: the scheduler thread serves many requests, so the
+    ticket's request ID must be stamped explicitly — sched_admit and
+    sched_retire spans carry ``rid``, sched_step carries the ``rids`` of
+    every row it drove, and the join/retire log records carry
+    ``request_id`` via the contextvar the record factory reads."""
+    _, sched = sched_stack
+    h = _Capture()
+    logger = logging.getLogger("dllama.runtime.scheduler")
+    old_level = logger.level
+    logger.addHandler(h)
+    logger.setLevel(logging.INFO)
+    try:
+        t = sched.submit(P1, 6)
+        rid = t.rid
+        assert list(t.tokens()) == solo_refs[tuple(P1)][:6]
+    finally:
+        logger.removeHandler(h)
+        logger.setLevel(old_level)
+    spans = obs_trace.TRACER.snapshot()
+    admits = [s for s in spans if s["name"] == "sched_admit"
+              and s["rid"] == rid]
+    retires = [s for s in spans if s["name"] == "sched_retire"
+               and s["rid"] == rid]
+    steps = [s for s in spans if s["name"] == "sched_step"
+             and rid in s["args"].get("rids", ())]
+    assert len(admits) == 1 and admits[0]["args"]["queued_ms"] >= 0
+    assert len(retires) == 1 and retires[0]["args"]["reason"] == "length"
+    assert steps, "every dispatch span must name the rows it drove"
+    tagged = [r for r in h.records
+              if getattr(r, "request_id", None) == rid]
+    msgs = {r.getMessage() for r in tagged}
+    assert any("join" in m for m in msgs), msgs
+    assert any("retire" in m for m in msgs), msgs
+
+
+def test_goodput_components_sum_to_wall_window(solo_refs, sched_stack):
+    """Acceptance: the goodput decomposition telescopes — prefill +
+    decode + pad + host_gap + idle account for the whole first-dispatch →
+    last-dispatch wall, within 5%."""
+    _, sched = sched_stack
+    tickets = [sched.submit(p, 8) for p in PROMPTS]
+    for p, t in zip(PROMPTS, tickets):
+        assert list(t.tokens()) == solo_refs[tuple(p)][:8]
+    window = sched.wall_window()
+    assert window is not None
+    wall_ms = (window[1] - window[0]) * 1e3
+    comp_ms = sum(sched._comp.values())
+    assert comp_ms == pytest.approx(wall_ms, rel=0.05), \
+        (dict(sched._comp), wall_ms)
+    busy = sched._comp["prefill"] + sched._comp["decode"]
+    assert 0 < busy <= comp_ms
+
+
+def test_timeline_entries_name_slot_phases(solo_refs, sched_stack):
+    _, sched = sched_stack
+    obs_flight.TIMELINE.clear()
+    t = sched.submit(P2, 6)
+    assert list(t.tokens()) == solo_refs[tuple(P2)][:6]
+    steps = obs_flight.TIMELINE.snapshot()
+    assert steps, "dispatches must land in the timeline"
+    rid = t.rid
+    phases_seen = set()
+    for e in steps:
+        assert len(e["slots"]) == 4  # one entry per slot, every step
+        assert e["wall_ms"] >= 0 and e["host_gap_ms"] >= 0
+        for s in e["slots"]:
+            if s.get("request_id") == rid:
+                phases_seen.add(s["phase"])
+    assert "prefill" in phases_seen and "decode" in phases_seen

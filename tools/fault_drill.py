@@ -66,13 +66,16 @@ class Server:
     """One api-server subprocess on the tiny fixture model."""
 
     def __init__(self, model: str, tokenizer: str, *, faults: str = "",
-                 extra_flags: list[str] | None = None):
+                 extra_flags: list[str] | None = None,
+                 env_extra: dict | None = None):
         from fixtures import cpu_env, free_port
         self.port = free_port()
         self.base = f"http://127.0.0.1:{self.port}"
         env = cpu_env()
         if faults:
             env["DLLAMA_FAULTS"] = faults
+        if env_extra:
+            env.update(env_extra)
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "dllama_tpu.server.api",
              "--model", model, "--tokenizer", tokenizer,
@@ -372,6 +375,51 @@ def drill_slot_churn(model, tok):
         s.stop()
 
 
+def drill_slo_burn(model, tok):
+    """An injected per-dispatch delay burns the ITL error budget: /health
+    flips to violating with slo_violations_total >= 1, then recovers to
+    ok after the (self-clearing) fault stops firing and the bad
+    observations age out of both burn windows."""
+    # --chunk 1 puts every decode token on its own delayed dispatch
+    # (decode bursts would cluster the delay at burst boundaries); the
+    # 0.3s delay beats the 0.25s bucket the 120ms target resolves to,
+    # and x25 self-clears after roughly one request's worth of steps
+    s = Server(model, tok, faults="engine.device_step=delay:0.3x25",
+               extra_flags=["--slo", "itl_p99=120ms", "--chunk", "1"],
+               env_extra={"DLLAMA_SLO_WINDOWS": "3s,10s"})
+    try:
+        s.wait_ready()
+        h = get(s.base, "/health")
+        assert h["slo"] is not None, "SLO engine must be armed"
+        with post(s.base, dict(BODY, stream=True)) as r:
+            assert b"[DONE]" in r.read()
+        slo = get(s.base, "/health")["slo"]
+        obj = slo["objectives"]["itl_p99"]
+        assert slo["status"] == "violating", slo
+        assert obj["verdict"] == "violating", slo
+        assert all(b >= 1.0 for b in obj["burn"].values()), slo
+        viol = get(s.base, "/metrics")["slo_violations"]
+        assert viol.get("itl_p99", 0) >= 1, viol
+        # recovery: the fault budget is exhausted; a clean request and
+        # ageing windows (3s/10s) must walk the verdict back to ok
+        with post(s.base, dict(BODY, stream=True)) as r:
+            assert b"[DONE]" in r.read()
+        deadline = time.monotonic() + 40
+        while time.monotonic() < deadline:
+            slo = get(s.base, "/health")["slo"]
+            if slo["status"] == "ok":
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError(f"never recovered: {slo}")
+        # the violation count is a transition counter, not a scrape
+        # counter: recovery must not have inflated it
+        viol2 = get(s.base, "/metrics")["slo_violations"]
+        assert viol2.get("itl_p99", 0) == viol.get("itl_p99"), viol2
+    finally:
+        s.stop()
+
+
 DRILLS = {
     "deadline": drill_deadline,
     "disconnect": drill_disconnect,
@@ -382,6 +430,7 @@ DRILLS = {
     "snapshot_restart": drill_snapshot_restart,
     "latency_histogram": drill_latency_histogram,
     "slot_churn": drill_slot_churn,
+    "slo_burn": drill_slo_burn,
 }
 
 

@@ -7,8 +7,16 @@ or https://ui.perfetto.dev — the cheap first-line latency attribution for
 a live server (queue_wait / prefill / decode_chunk / emit / request spans
 per request ID), no restart and no ``--profile-split`` XLA tracer needed.
 
+With ``--slots`` it also fetches ``GET /debug/timeline`` (the scheduler's
+per-dispatch slot timeline, obs/flight.py) and appends one named Perfetto
+track per scheduler slot (pid 2): every dispatch becomes one event per
+slot, named by that slot's phase (``prefill``/``decode``/``pad``), so the
+goodput decomposition is visible as colored bars next to the request
+spans — both use the same ``perf_counter`` clock.
+
 Usage:
     python tools/trace_dump.py http://127.0.0.1:9090 [-o trace.json] [-n 20]
+    python tools/trace_dump.py http://127.0.0.1:9090 --slots
 """
 
 from __future__ import annotations
@@ -24,6 +32,41 @@ def fetch_trace(base: str, last: int, timeout: float = 10.0) -> dict:
     url = f"{base.rstrip('/')}/debug/trace?last={last}"
     with urllib.request.urlopen(url, timeout=timeout) as r:
         return json.loads(r.read().decode("utf-8"))
+
+
+def fetch_timeline(base: str, n: int = 256, timeout: float = 10.0) -> dict:
+    url = f"{base.rstrip('/')}/debug/timeline?n={n}"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def slot_events(doc: dict) -> list[dict]:
+    """Chrome ``trace_event`` array for the slot timeline: pid 2, one
+    named thread per scheduler slot, one X event per (dispatch, slot)
+    named by the slot's phase in that dispatch."""
+    steps = doc.get("steps", [])
+    nslots = doc.get("slots", 0) or max(
+        (len(e.get("slots", [])) for e in steps), default=0)
+    events = [{"name": "process_name", "ph": "M", "pid": 2, "tid": 0,
+               "args": {"name": "slot timeline"}}]
+    for s in range(nslots):
+        events.append({"name": "thread_name", "ph": "M", "pid": 2,
+                       "tid": s, "args": {"name": f"slot {s}"}})
+    for e in steps:
+        ts = round(e["ts"] * 1e6, 3)
+        dur = round(e["wall_ms"] * 1e3, 3)
+        for slot in e.get("slots", []):
+            args = {"tokens": slot.get("tokens", 0),
+                    "steps": e.get("steps"), "t_width": e.get("t_width")}
+            if slot.get("request_id"):
+                args["request_id"] = slot["request_id"]
+            if e.get("error"):
+                args["error"] = True
+            events.append({"name": slot.get("phase", "?"), "cat": "sched",
+                           "ph": "X", "ts": ts, "dur": dur,
+                           "pid": 2, "tid": slot.get("slot", 0),
+                           "args": args})
+    return events
 
 
 def summarize(doc: dict) -> str:
@@ -49,6 +92,11 @@ def main(argv=None) -> int:
                     help="output file (default trace.json)")
     ap.add_argument("-n", "--last", type=int, default=20,
                     help="number of most-recent requests to include")
+    ap.add_argument("--slots", action="store_true",
+                    help="also fetch /debug/timeline and add one Perfetto "
+                         "track per scheduler slot (phase-named events)")
+    ap.add_argument("--timeline-n", type=int, default=256,
+                    help="with --slots: number of most-recent dispatches")
     ap.add_argument("--timeout", type=float, default=10.0)
     args = ap.parse_args(argv)
 
@@ -60,6 +108,20 @@ def main(argv=None) -> int:
     if not doc.get("traceEvents"):
         print("trace_dump: no spans recorded yet (serve a request first)",
               file=sys.stderr)
+    if args.slots:
+        try:
+            tl = fetch_timeline(args.base, args.timeline_n, args.timeout)
+        except Exception as e:
+            print(f"trace_dump: timeline fetch failed: {e}", file=sys.stderr)
+            return 1
+        doc["traceEvents"] = doc.get("traceEvents", []) + slot_events(tl)
+        gp = tl.get("goodput_ratio")
+        comp = tl.get("components_ms") or {}
+        if comp:
+            split = " ".join(f"{k}={v:.0f}ms"
+                             for k, v in sorted(comp.items()))
+            print(f"goodput {gp:.3f} over {len(tl.get('steps', []))} "
+                  f"dispatches: {split}")
     with open(args.out, "w") as f:
         json.dump(doc, f)
     print(f"wrote {args.out} — load it in chrome://tracing or "
