@@ -159,6 +159,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "slot, clamp on-device decode bursts so a finishing "
                         "stream frees its slot within about this many "
                         "milliseconds")
+    p.add_argument("--sched-max-queue", type=int, default=32,
+                   help="continuous batching: max requests waiting for a "
+                        "slot (beyond free slots); excess submissions get "
+                        "429 + Retry-After")
+    p.add_argument("--kv-pages", type=int, default=0,
+                   help="slot scheduler: back the slot KV cache with a paged "
+                        "pool of this many pages instead of per-slot "
+                        "contiguous rows (page 0 is reserved scratch).  "
+                        "Pages are allocated per request at admission and "
+                        "shared across requests with identical prompt "
+                        "prefixes (radix prefix cache), so the pool can be "
+                        "sized well below slots x max-seq-len "
+                        "(docs/PERF.md).  0 = contiguous (default)")
+    p.add_argument("--kv-page-size", type=int, default=16,
+                   help="paged KV: tokens per page; prefix sharing works in "
+                        "whole pages, so smaller pages share more of a "
+                        "common prompt but make longer page tables")
+    p.add_argument("--no-prefix-reuse", action="store_true",
+                   help="paged KV: disable the radix prefix cache (pages "
+                        "are still pooled; nothing is shared or retained "
+                        "across requests) — A/B baseline for "
+                        "prefix_tokens_reused metrics")
     # ---- serving robustness (api server; docs/ROBUSTNESS.md) ----
     p.add_argument("--host", default="0.0.0.0",
                    help="api server: bind address (default 0.0.0.0)")

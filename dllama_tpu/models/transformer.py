@@ -30,9 +30,10 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import q40, q8
-from ..ops.attention import (gqa_attention_at, quantize_kv,
-                             slot_gqa_attention_at, update_kv_cache_at,
-                             update_kv_cache_rows)
+from ..ops.attention import (gqa_attention_at, paged_gqa_attention_at,
+                             paged_update_kv_rows, paged_write_indices,
+                             quantize_kv, slot_gqa_attention_at,
+                             update_kv_cache_at, update_kv_cache_rows)
 from ..ops.kernels import ACTIVATIONS, apply_rope, rmsnorm, rope_angles, softmax_f32
 from ..ops.sp_attention import ring_attention, sp_gqa_attention, sp_update_kv_cache_at
 from ..parallel.mesh import get_active_mesh
@@ -86,6 +87,21 @@ def init_kv_cache(cfg: ModelConfig, batch: int, seq_len: int | None = None,
     return KVCache(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
 
 
+def init_kv_pool(cfg: ModelConfig, n_pages: int, page_size: int,
+                 dtype=None) -> KVCache:
+    """Paged KV pool: the stacked layout with the batch axis generalized
+    to physical pages and the sequence axis shrunk to one page —
+    ``(L, n_pages, Hkv, page_size, Dh)``.  Axis-for-axis compatible with
+    the contiguous cache's sharding spec (pages ride the batch axis, the
+    page interior rides the sequence axis).  Page 0 is the reserved
+    scratch page (see ops.attention paged section); slots address the
+    pool through per-slot page tables, so pool memory is bounded by live
+    *tokens*, not slots × max-seq."""
+    shape = (cfg.n_layers, n_pages, cfg.n_kv_heads, page_size, cfg.head_size)
+    dt = dtype or cfg.dtype
+    return KVCache(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+
+
 def _mm(x, w, cfg: ModelConfig, kind: str | None = None):
     """Matmul that accepts dense arrays or packed Q40 weights.  Weight
     dtype/format is a per-tensor property (the reference likewise
@@ -114,7 +130,7 @@ def update_cache_at(cache: KVCache, k_new, v_new, layer, pos) -> KVCache:
 
 
 def _attention_block(x, lp, cfg: ModelConfig, cache: KVCache, cos, sin, pos,
-                     layer, offsets=None, pos_rows=None):
+                     layer, offsets=None, pos_rows=None, paged=None):
     """One attention sub-block.  ``cache`` holds the *stacked*
     (L, B, Hkv, S, Dh) buffers carried through the layer scan; this layer
     writes its (B, Hkv, T, Dh) step window in place at ``(layer, pos)`` and
@@ -148,9 +164,21 @@ def _attention_block(x, lp, cfg: ModelConfig, cache: KVCache, cos, sin, pos,
         # continuous-batching slots: per-row write positions and per-row
         # causal ceilings (sp meshes and quantized caches are gated off
         # the slot path at the engine boundary)
-        ck, cv = update_kv_cache_rows(cache.k, cache.v, k, v, layer, pos_rows)
-        cache = KVCache(ck, cv)
-        att = slot_gqa_attention_at(q, cache.k, cache.v, layer, pos_rows)
+        if paged is not None:
+            # paged pool: same slot semantics, reads/writes indirected
+            # through the page table (write indices precomputed once in
+            # forward_slots — identical for every layer)
+            page_table, pidx, oidx = paged
+            ck, cv = paged_update_kv_rows(cache.k, cache.v, k, v, layer,
+                                          pidx, oidx)
+            cache = KVCache(ck, cv)
+            att = paged_gqa_attention_at(q, cache.k, cache.v, layer,
+                                         page_table, pos_rows)
+        else:
+            ck, cv = update_kv_cache_rows(cache.k, cache.v, k, v, layer,
+                                          pos_rows)
+            cache = KVCache(ck, cv)
+            att = slot_gqa_attention_at(q, cache.k, cache.v, layer, pos_rows)
         att = att.transpose(0, 2, 1, 3).reshape(b, t, hq * dh)
         return _mm(att, lp["wo"], cfg, kind="col"), cache
     if t == 1 and sp_on:
@@ -303,8 +331,8 @@ def moe_ffn(xb2d: jax.Array, lp, cfg: ModelConfig) -> jax.Array:
 def run_blocks(params: Params, cfg: ModelConfig, tokens: jax.Array,
                cache: KVCache, pos: jax.Array,
                offsets: jax.Array | None = None,
-               pos_rows: jax.Array | None = None
-               ) -> tuple[jax.Array, KVCache]:
+               pos_rows: jax.Array | None = None,
+               paged=None) -> tuple[jax.Array, KVCache]:
     """Embed + all transformer blocks; returns the residual stream (B, T, D)
     and the updated cache.
 
@@ -351,7 +379,7 @@ def run_blocks(params: Params, cfg: ModelConfig, tokens: jax.Array,
             lp[k] = q40.QLayerView(params[k], idx)
         att_out, kvc = _attention_block(x, lp, cfg, kvc, cos, sin, pos,
                                         idx, offsets=offsets,
-                                        pos_rows=pos_rows)
+                                        pos_rows=pos_rows, paged=paged)
         if cfg.post_block_norms:
             att_out = rmsnorm(att_out, lp["rms_ffn"])  # grokRmfFfnNorm
         x = x + att_out
@@ -416,7 +444,8 @@ def forward_last(params: Params, cfg: ModelConfig, tokens: jax.Array,
 
 
 def forward_slots(params: Params, cfg: ModelConfig, tokens: jax.Array,
-                  cache: KVCache, pos_rows: jax.Array, n_valid: jax.Array
+                  cache: KVCache, pos_rows: jax.Array, n_valid: jax.Array,
+                  page_table: jax.Array | None = None
                   ) -> tuple[jax.Array, KVCache]:
     """Continuous-batching slot step: run ``tokens`` (B, T) where row ``r``
     occupies cache positions ``pos_rows[r]..pos_rows[r]+T-1`` and only its
@@ -432,10 +461,22 @@ def forward_slots(params: Params, cfg: ModelConfig, tokens: jax.Array,
     Garbage written above a row's ``n_valid`` window lands at positions
     the row has not reached yet — masked by its causal ceiling until the
     real tokens overwrite them (see ops.attention.slot_gqa_attention_at).
+
+    With ``page_table`` (B, max_pages) the cache is a paged pool
+    (:func:`init_kv_pool`) and every read/write is indirected through the
+    table; logical semantics — positions, ceilings, RoPE clocks — are
+    unchanged, which is what makes paged greedy output byte-identical to
+    the contiguous layout.  Invalid-token writes are redirected to the
+    scratch page instead of landing above the ceiling.
     """
     t = tokens.shape[1]
+    paged = None
+    if page_table is not None:
+        ps = cache.k.shape[3]
+        pidx, oidx = paged_write_indices(page_table, pos_rows, n_valid, t, ps)
+        paged = (page_table, pidx, oidx)
     x, cache = run_blocks(params, cfg, tokens, cache, jnp.int32(0),
-                          pos_rows=pos_rows)
+                          pos_rows=pos_rows, paged=paged)
     idx = jnp.clip(n_valid - 1, 0, t - 1)
     x_last = jax.vmap(
         lambda row, i: jax.lax.dynamic_index_in_dim(row, i, 0, keepdims=False)

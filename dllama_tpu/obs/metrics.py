@@ -570,6 +570,28 @@ SCHED_SLOT_RETIRES = REGISTRY.labeled_counter(
     "Requests retired from a batch slot, by slot index and reason "
     "(stop/length/timeout/aborted/error/drain).")
 
+# paged KV pool + radix prefix cache (runtime/pagepool.py, driven by the
+# scheduler).  Pages bound KV memory by live tokens instead of
+# slots × max-seq; prefix hits replace re-prefill with shared pages.
+KV_PAGES_TOTAL = REGISTRY.gauge(
+    "kv_pages_total",
+    "Usable pages in the paged KV pool (page 0, the reserved scratch "
+    "page, excluded).")
+KV_PAGES_IN_USE = REGISTRY.gauge(
+    "kv_pages_in_use",
+    "KV pages currently referenced by live slots or the prefix cache.")
+PREFIX_HITS = REGISTRY.counter(
+    "prefix_hits",
+    "Admissions whose prompt matched a cached prefix in the radix tree.")
+PREFIX_TOKENS_REUSED = REGISTRY.counter(
+    "prefix_tokens_reused",
+    "Prompt tokens bound to shared KV pages instead of being "
+    "re-prefilled.")
+KV_POOL_EXHAUSTED = REGISTRY.counter(
+    "kv_pool_exhausted",
+    "Admissions deferred because the page pool had no free pages (the "
+    "request waits queued until retirements free pages).")
+
 # device-memory telemetry: per-device HBM gauges.  The reader fn is bound
 # by runtime/engine.py at import (jax stays out of the obs package);
 # backends without memory_stats (CPU) expose an empty family, not zeros.

@@ -99,33 +99,19 @@ def update_kv_cache_rows(k_cache: jax.Array, v_cache: jax.Array,
         k_cache, v_cache, k_new, v_new, pos_rows)
 
 
-def slot_gqa_attention_at(q: jax.Array, ck: jax.Array, cv: jax.Array,
-                          layer: jax.Array, pos_rows: jax.Array) -> jax.Array:
-    """One-shot causal GQA over the *stacked* caches at ``layer`` with a
-    **per-row** causal ceiling: row ``r``'s query tokens occupy cache
-    positions ``pos_rows[r]..pos_rows[r]+T-1`` and may see key positions
-    ``<= pos_rows[r] + t_local`` only.
-
-    This is the attention read of the continuous-batching slot step.
-    Unlike the ragged-batch path there is no key *floor*: every slot's
-    request starts at cache position 0, and a freed slot is reused by
-    simply resetting its position — the previous occupant's stale keys
-    sit *above* the new request's ceiling, masked until each position is
-    overwritten by the new occupant (write-before-visible).  Zeroing the
-    row instead would be wrong twice over: it costs an O(S) write, and a
-    zero key is a *real* key (it would contribute exp(0-ish) mass to the
-    softmax denominator).
-
-    Per-step traffic is O(S) like the one-shot decode path; slot serving
-    targets the throughput regime (batch > 1, moderate context) where the
-    weight read — amortized over B rows — dominates.
-    """
+def _rows_ceiling_attention(q: jax.Array, k_l: jax.Array, v_l: jax.Array,
+                            pos_rows: jax.Array) -> jax.Array:
+    """One-shot causal GQA over one layer's K/V (B, Hkv, S, Dh) with a
+    **per-row** causal ceiling: row ``r``'s query tokens occupy positions
+    ``pos_rows[r]..pos_rows[r]+T-1`` and may see key positions
+    ``<= pos_rows[r] + t_local`` only.  Shared by the contiguous slot
+    read (:func:`slot_gqa_attention_at`) and the paged gather-view read
+    (:func:`paged_gqa_attention_at`) so the two layouts cannot drift on
+    masking or accumulation dtype."""
     b, hq, t, dh = q.shape
-    hkv = ck.shape[2]
-    s = ck.shape[3]
+    hkv = k_l.shape[1]
+    s = k_l.shape[2]
     g = hq // hkv
-    k_l = jax.lax.dynamic_index_in_dim(ck, layer, 0, keepdims=False)
-    v_l = jax.lax.dynamic_index_in_dim(cv, layer, 0, keepdims=False)
 
     # operands in cache dtype, f32 accumulation — see _online_fold for why
     qc = q.reshape(b, hkv, g, t, dh).astype(k_l.dtype)
@@ -142,6 +128,152 @@ def slot_gqa_attention_at(q: jax.Array, ck: jax.Array, cv: jax.Array,
     out = jnp.einsum("bhgts,bhsd->bhgtd", probs.astype(v_l.dtype), v_l,
                      preferred_element_type=jnp.float32)
     return out.reshape(b, hq, t, dh).astype(q.dtype)
+
+
+def slot_gqa_attention_at(q: jax.Array, ck: jax.Array, cv: jax.Array,
+                          layer: jax.Array, pos_rows: jax.Array) -> jax.Array:
+    """One-shot causal GQA over the *stacked* caches at ``layer`` with a
+    **per-row** causal ceiling (see :func:`_rows_ceiling_attention`).
+
+    This is the attention read of the continuous-batching slot step.
+    Unlike the ragged-batch path there is no key *floor*: every slot's
+    request starts at cache position 0, and a freed slot is reused by
+    simply resetting its position — the previous occupant's stale keys
+    sit *above* the new request's ceiling, masked until each position is
+    overwritten by the new occupant (write-before-visible).  Zeroing the
+    row instead would be wrong twice over: it costs an O(S) write, and a
+    zero key is a *real* key (it would contribute exp(0-ish) mass to the
+    softmax denominator).
+
+    Per-step traffic is O(S) like the one-shot decode path; slot serving
+    targets the throughput regime (batch > 1, moderate context) where the
+    weight read — amortized over B rows — dominates.
+    """
+    k_l = jax.lax.dynamic_index_in_dim(ck, layer, 0, keepdims=False)
+    v_l = jax.lax.dynamic_index_in_dim(cv, layer, 0, keepdims=False)
+    return _rows_ceiling_attention(q, k_l, v_l, pos_rows)
+
+
+# ---------------------------------------------------------------------------
+# Paged KV: a global page pool + per-slot block tables (PagedAttention).
+#
+# The pool is ``(L, n_pages, Hkv, page_size, Dh)`` — the contiguous stacked
+# layout with the batch axis generalized to physical pages and the sequence
+# axis shrunk to one page.  A slot's logical cache is described by one
+# (max_pages,) int32 row of the page table, shared across layers: logical
+# position ``p`` of slot ``r`` lives at ``pool[:, table[r, p // ps], :,
+# p % ps]``.  Physical page 0 is reserved as a scratch page: table entries
+# past a slot's reserved pages point at it, and every *invalid* token write
+# (decode padding, tokens past ``n_valid``, burst overshoot past a retired
+# row's budget) is redirected there — so shared prefix pages are immutable
+# by construction and garbage lands where no mask can ever expose it.
+
+
+def paged_write_indices(page_table: jax.Array, pos_rows: jax.Array,
+                        n_valid: jax.Array, t: int, page_size: int
+                        ) -> tuple[jax.Array, jax.Array]:
+    """Physical (page, offset) index arrays, both (B, T) int32, for one
+    slot step's KV writes through the page table.
+
+    Computed ONCE per forward (outside the layer scan — every layer writes
+    the same logical positions).  Invalid tokens (``t_local >= n_valid``)
+    are redirected to scratch page 0; logical pages past the table width
+    clamp into it, where unreserved entries already hold 0."""
+    maxp = page_table.shape[1]
+    tpos = pos_rows[:, None] + jnp.arange(t)[None, :]          # (B, T)
+    valid = jnp.arange(t)[None, :] < n_valid[:, None]          # (B, T)
+    pslot = jnp.clip(tpos // page_size, 0, maxp - 1)
+    pidx = jnp.take_along_axis(page_table, pslot, axis=1)
+    pidx = jnp.where(valid, pidx, 0)
+    oidx = tpos % page_size
+    return pidx.astype(jnp.int32), oidx.astype(jnp.int32)
+
+
+def paged_update_kv_rows(pool_k: jax.Array, pool_v: jax.Array,
+                         k_new: jax.Array, v_new: jax.Array,
+                         layer: jax.Array, pidx: jax.Array, oidx: jax.Array
+                         ) -> tuple[jax.Array, jax.Array]:
+    """Write one layer's step KV (B, Hkv, T, Dh) into the paged pools
+    (L, P, Hkv, ps, Dh) at per-token physical ``(page, offset)`` indices
+    (B, T) from :func:`paged_write_indices`.
+
+    One advanced-indexing scatter per pool: the (B, T) page/offset arrays
+    are non-adjacent advanced indices (the Hkv slice sits between), so the
+    update operand is (B, T, Hkv, Dh) — the step KV with its token axis
+    moved ahead of the head axis.  Invalid tokens all target scratch page
+    0; colliding scratch writes are unordered, which is fine — nothing
+    reads that page unmasked."""
+    kbt = k_new.transpose(0, 2, 1, 3).astype(pool_k.dtype)  # (B, T, Hkv, Dh)
+    vbt = v_new.transpose(0, 2, 1, 3).astype(pool_v.dtype)
+    li = layer.astype(jnp.int32)
+    pool_k = pool_k.at[li, pidx, :, oidx].set(kbt)
+    pool_v = pool_v.at[li, pidx, :, oidx].set(vbt)
+    return pool_k, pool_v
+
+
+def paged_gather_layer(pool: jax.Array, layer: jax.Array,
+                       page_table: jax.Array) -> jax.Array:
+    """Materialize one layer's logical KV view (B, Hkv, maxp·ps, Dh) by
+    gathering each slot's pages from the pool (L, P, Hkv, ps, Dh).  The
+    gather is the paged twin of the contiguous layer slice: XLA fuses it
+    into the score dot for the short-cache one-shot path, and the
+    long-cache decode path avoids it entirely (page-walk fold)."""
+    pl = jax.lax.dynamic_index_in_dim(pool, layer, 0, keepdims=False)
+    view = pl[page_table]  # (B, maxp, Hkv, ps, Dh)
+    b, maxp, hkv, ps, dh = view.shape
+    return view.transpose(0, 2, 1, 3, 4).reshape(b, hkv, maxp * ps, dh)
+
+
+def paged_decode_attention(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
+                           layer: jax.Array, page_table: jax.Array,
+                           pos_rows: jax.Array) -> jax.Array:
+    """Single-token decode over the paged pool that walks only live pages:
+    :func:`blocked_live_fold` with the page as the block (the pool already
+    stores fixed-size KV chunks — pages ARE the fold's block granularity)
+    and one pool gather per step in place of the contiguous block slice.
+    Per-row ceilings ride the fold's ``row_pos`` mask; rows whose table
+    runs out before the longest neighbor read scratch page 0, fully
+    masked."""
+    b, hq, t, dh = q.shape
+    hkv = pool_k.shape[2]
+    ps = pool_k.shape[3]
+    maxp = page_table.shape[1]
+    g = hq // hkv
+    qf = q.astype(jnp.float32).reshape(b, hkv, g, t, dh)
+
+    def slice_page(pool, start, length):
+        pid = jax.lax.dynamic_index_in_dim(page_table, start // ps, 1,
+                                           keepdims=False)  # (B,)
+        # advanced (scalar layer, (B,) page) indexing: one (B, Hkv, ps, Dh)
+        # page gather per fold step — never the whole layer slab
+        return pool[layer.astype(jnp.int32), pid]
+
+    _, l, acc = blocked_live_fold(qf, slice_page, pool_k, pool_v,
+                                  jnp.max(pos_rows), jnp.int32(0), maxp * ps,
+                                  row_pos=pos_rows, block=ps)
+    out = acc / jnp.maximum(l, 1e-38)[..., None]
+    return out.reshape(b, hq, t, dh).astype(q.dtype)
+
+
+def paged_gqa_attention_at(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
+                           layer: jax.Array, page_table: jax.Array,
+                           pos_rows: jax.Array) -> jax.Array:
+    """Causal GQA read through the page-table indirection at ``layer``,
+    with the slot path's per-row causal ceiling.  Dispatch mirrors the
+    contiguous path: long-cache single-token decode walks live pages
+    (:func:`paged_decode_attention`, O(max pos) traffic); everything else
+    gathers the logical view and reuses the one-shot slot math, so paged
+    and contiguous reads are the same computation over the same logical
+    keys."""
+    t = q.shape[2]
+    ps = pool_k.shape[3]
+    s = page_table.shape[1] * ps
+    if _use_blocked_decode(t, s):
+        return paged_decode_attention(q, pool_k, pool_v, layer, page_table,
+                                      pos_rows)
+    k_l = paged_gather_layer(pool_k, layer, page_table)
+    v_l = paged_gather_layer(pool_v, layer, page_table)
+    return _rows_ceiling_attention(q, k_l, v_l, pos_rows)
 
 
 # Above this many score elements per kv-head group, prefill switches to the
@@ -250,21 +382,30 @@ def _use_blocked_decode(t: int, s: int) -> bool:
 
 
 def blocked_live_fold(qf, slice_block, k_cache, v_cache, pos, base, c,
-                      wrap=lambda x: x, row_start: jax.Array | None = None):
+                      wrap=lambda x: x, row_start: jax.Array | None = None,
+                      row_pos: jax.Array | None = None,
+                      block: int | None = None):
     """The length-aware online-softmax core: walk only the KV blocks of a
     chunk of length ``c`` (global position offset ``base``) that cover
     live positions ≤ ``pos``, folding each into the running (max, denom,
     numerator).  Shared by :func:`decode_gqa_attention` (base 0, whole
-    cache) and the sequence-parallel per-shard partials (base = the
-    shard's chunk start) so the block walk cannot drift between them.
+    cache), the sequence-parallel per-shard partials (base = the shard's
+    chunk start), and the paged decode walk (block = one KV page) so the
+    block walk cannot drift between them.
 
     ``slice_block(cache, start, length)`` cuts one (B, Hkv, length, Dh)
     block; ``wrap`` marks fresh accumulators (shard_map bodies pass a
-    device-varying cast).  Returns raw ``(m, l, acc)`` — callers gated on
-    a non-empty live region fold at least one block, so ``m`` is a real
-    max.  The caller normalizes (``acc / l``) or combines partials."""
+    device-varying cast).  ``row_pos`` (B,) replaces the scalar causal
+    ceiling with a per-row one (T must be 1): ``pos`` then only bounds
+    the walk — pass its row max — while each row masks at its own
+    ceiling.  ``block`` overrides the auto-tuned chunk width when the
+    storage layout fixes the granularity (paged pools walk page-sized
+    blocks).  Returns raw ``(m, l, acc)`` — callers gated on a non-empty
+    live region fold at least one block, so ``m`` is a real max.  The
+    caller normalizes (``acc / l``) or combines partials."""
     b, hkv, g, t, dh = qf.shape
-    block = _kv_chunk(c)
+    if block is None:
+        block = _kv_chunk(c)
     scale = 1.0 / jnp.sqrt(jnp.float32(dh))
     local_last = jnp.clip(pos - base, 0, c - 1)
     n_live = local_last // block + 1
@@ -278,9 +419,13 @@ def blocked_live_fold(qf, slice_block, k_cache, v_cache, pos, base, c,
         kb = slice_block(k_cache, start, block)
         vb = slice_block(v_cache, start, block)
         s_idx = base + start + jnp.arange(block)
-        mask = (s_idx <= pos)[None, :]
+        if row_pos is not None:  # slot batch: per-row causal ceiling
+            mask = s_idx[None, None, :] <= row_pos[:, None, None]  # (B, 1, blk)
+        else:
+            mask = (s_idx <= pos)[None, :]
         if row_start is not None:  # ragged batch: per-row key floor
-            mask = mask[None] & (s_idx[None, None] >= row_start[:, None, None])
+            floor = s_idx[None, None] >= row_start[:, None, None]
+            mask = (mask if mask.ndim == 3 else mask[None]) & floor
         m, l, acc = _online_fold(qf, kb, vb, mask, m, l, acc, scale)
         return i + 1, m, l, acc
 

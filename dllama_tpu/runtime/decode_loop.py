@@ -110,7 +110,7 @@ def device_sample_rows(logits: jax.Array, key: jax.Array, temps: jax.Array,
 def slot_chunk(params, cfg: ModelConfig, cache: KVCache, tokens: jax.Array,
                pos_rows: jax.Array, n_valid: jax.Array, key: jax.Array,
                temps: jax.Array, topps: jax.Array, *, steps: int,
-               greedy: bool):
+               greedy: bool, page_table: jax.Array | None = None):
     """One continuous-batching dispatch: a mixed prefill/decode forward
     over (B, T) slot rows, then ``steps - 1`` pure decode steps — all one
     XLA program, so slot serving keeps decode_chunk's amortization (only
@@ -125,9 +125,14 @@ def slot_chunk(params, cfg: ModelConfig, cache: KVCache, tokens: jax.Array,
 
     Returns (tokens (steps, B), cache).  The caller advances per-slot
     positions host-side (``pos += n_valid``, then +1 per extra step).
+
+    ``page_table`` (B, max_pages) switches the cache to a paged pool:
+    pages are pre-reserved at admission for the whole request (prompt +
+    budget), so the table is constant across the chunk and rides the
+    compiled program as one extra int32 operand.
     """
     logits, cache = forward_slots(params, cfg, tokens, cache, pos_rows,
-                                  n_valid)
+                                  n_valid, page_table=page_table)
     key, sub = jax.random.split(key)
     first = device_sample_rows(logits, sub, temps, topps, greedy)
     pos_rows = pos_rows + n_valid
@@ -135,7 +140,8 @@ def slot_chunk(params, cfg: ModelConfig, cache: KVCache, tokens: jax.Array,
     def body(carry, _):
         cache, tok, pos_rows, key = carry
         logits, cache = forward_slots(params, cfg, tok[:, None], cache,
-                                      pos_rows, jnp.ones_like(pos_rows))
+                                      pos_rows, jnp.ones_like(pos_rows),
+                                      page_table=page_table)
         key, sub = jax.random.split(key)
         nxt = device_sample_rows(logits, sub, temps, topps, greedy)
         return (cache, nxt, pos_rows + 1, key), nxt

@@ -212,7 +212,8 @@ class Engine:
                  batch: int = 1, seq_len: int | None = None, kv_dtype=None,
                  timing_mode: str | None = None,
                  step_timeout: float | None = None,
-                 numeric_checks: bool | None = None):
+                 numeric_checks: bool | None = None,
+                 kv_pages: int = 0, kv_page_size: int = 16):
         self.batch = batch
         # decode watchdog (see StepTimeout); 0/None disables.  Env default
         # so a live server can arm it without a code path change.
@@ -306,11 +307,44 @@ class Engine:
         # placement and step outputs can never silently diverge
         self._cache_sh = sharding.kv_cache_sharding(
             self.mesh, "sp" if self.sp > 1 else None)
-        self.cache = jax.device_put(
-            init_kv_cache(cfg, batch, self.seq_len,
-                          dtype=None if kv_quant else kv_dtype,
-                          quant=kv_quant),
-            self._cache_sh)
+        # kv_pages > 0 replaces the per-slot contiguous cache with a paged
+        # pool + per-slot page tables (ops/attention.py paged section):
+        # memory is bounded by live tokens, not batch × seq_len, and the
+        # scheduler's radix tree can share prompt-prefix pages across
+        # requests.  Slot-serving only: the one-shot conversation/batch
+        # paths keep contiguous addressing.
+        self.paged = kv_pages > 0
+        self.kv_pages = int(kv_pages)
+        self.kv_page_size = int(kv_page_size)
+        if self.paged:
+            if self.sp > 1:
+                raise ValueError("paged KV is not supported on sp meshes "
+                                 "(sequence-sharded pools are not wired)")
+            if kv_quant:
+                raise ValueError("paged KV needs a dense cache dtype "
+                                 "(per-page quantized writes are not wired)")
+            if self.kv_pages < 2:
+                raise ValueError("kv_pages must be >= 2 (page 0 is the "
+                                 "reserved scratch page)")
+            if self.kv_page_size < 1:
+                raise ValueError(f"kv_page_size must be >= 1, "
+                                 f"got {self.kv_page_size}")
+            # per-slot table width: enough logical pages to cover seq_len
+            self.max_pages_per_slot = -(-self.seq_len // self.kv_page_size)
+            from ..models.transformer import init_kv_pool
+            # pool layout (L, P, Hkv, ps, Dh) is axis-compatible with the
+            # contiguous cache spec: pages ride the batch ("dp") axis, the
+            # page interior rides the sequence axis
+            self.cache = jax.device_put(
+                init_kv_pool(cfg, self.kv_pages, self.kv_page_size,
+                             dtype=kv_dtype),
+                self._cache_sh)
+        else:
+            self.cache = jax.device_put(
+                init_kv_cache(cfg, batch, self.seq_len,
+                              dtype=None if kv_quant else kv_dtype,
+                              quant=kv_quant),
+                self._cache_sh)
         self.pos = 0
 
         def step(params, cache, tokens, pos, last_index, offsets=None):
@@ -371,6 +405,9 @@ class Engine:
             "batch": self.batch, "seq_len": self.seq_len,
             "cache": [[n, str(a.dtype), list(a.shape)]
                       for n, a in self._cache_arrays().items()],
+            # pool geometry: a paged snapshot only means something in an
+            # engine with the same page count/size (page ids are physical)
+            "paged": [self.kv_pages, self.kv_page_size] if self.paged else None,
         }
         return snapfmt.fingerprint(fields)
 
@@ -382,13 +419,17 @@ class Engine:
         return out
 
     def snapshot(self, path: str | os.PathLike,
-                 extra: dict | None = None) -> str:
+                 extra: dict | None = None,
+                 extra_arrays: dict | None = None) -> str:
         """Serialize the engine's conversation state (KV cache, position,
         sampler RNG stream, ragged offsets) to a versioned, checksummed
         file (runtime/snapshot.py).  Atomic; returns the path.  ``extra``
         is caller JSON carried in the snapshot meta and handed back by
         :meth:`restore` (the API server stores its conversation cache
-        there so a warm restart resumes chats, not just KV bytes)."""
+        there so a warm restart resumes chats, not just KV bytes);
+        ``extra_arrays`` are caller numpy arrays stored alongside the
+        cache (the paged scheduler persists its page tables this way) and
+        handed back via :attr:`restored_arrays`."""
         from . import snapshot as snapfmt
         arrays = {n: np.asarray(a) for n, a in self._cache_arrays().items()}
         arrays["rng_key"] = np.asarray(self._key)
@@ -396,6 +437,10 @@ class Engine:
         if self._offsets is not None:
             arrays["offsets"] = np.asarray(self._offsets)
             meta_extra["has_offsets"] = True
+        for n, a in (extra_arrays or {}).items():
+            if n in arrays:
+                raise ValueError(f"extra array name {n!r} collides")
+            arrays[n] = np.asarray(a)
         return snapfmt.save(path, fingerprint=self.config_fingerprint(),
                             pos=self.pos, chunk_counter=self._chunk_counter,
                             arrays=arrays, extra=meta_extra)
@@ -451,6 +496,11 @@ class Engine:
             else jax.random.PRNGKey(0)
         self._offsets = jnp.asarray(arrays["offsets"]) \
             if meta.get("extra", {}).get("has_offsets") else None
+        # caller arrays saved via snapshot(extra_arrays=...) — e.g. the
+        # paged scheduler's page tables — handed back out-of-band
+        known = set(self._cache_arrays()) | {"rng_key", "offsets"}
+        self.restored_arrays = {n: a for n, a in arrays.items()
+                                if n not in known}
         bump_counter("snapshot_restores")
         return dict(meta.get("extra", {}))
 
@@ -542,6 +592,10 @@ class Engine:
 
     def _run(self, tokens_np: np.ndarray, last_index: int,
              offsets: jax.Array | None = None) -> tuple[np.ndarray, StepStats]:
+        if self.paged:
+            raise ValueError("paged engine is slot-only: the pool has no "
+                             "contiguous per-row addressing; drive it via "
+                             "slot_step / the slot scheduler")
         stats = StepStats()
         t0 = time.perf_counter()
         # from-scratch prefill on an sp mesh → blockwise ring attention with
@@ -969,7 +1023,8 @@ class Engine:
     # ------------------------------------------------------------------
     def slot_step(self, tokens_np: np.ndarray, pos_rows_np: np.ndarray,
                   n_valid_np: np.ndarray, *, temps_np: np.ndarray,
-                  topps_np: np.ndarray, steps: int = 1) -> np.ndarray:
+                  topps_np: np.ndarray, steps: int = 1,
+                  page_tables_np: np.ndarray | None = None) -> np.ndarray:
         """One continuous-batching dispatch over the slot-addressable
         batch: row ``r`` consumes its first ``n_valid_np[r]`` tokens of
         ``tokens_np`` (B, T) at its own cache positions
@@ -992,6 +1047,11 @@ class Engine:
         tracks every slot's clock host-side.  Compiled per
         ``(T, steps, all-greedy)``; temperature/top-p ride in as (B,)
         arrays so heterogeneous requests share one program.
+
+        On a paged engine ``page_tables_np`` (B, max_pages) int32 is
+        required: reads/writes indirect through it into the pool
+        (decode_loop.slot_chunk).  Its shape is static per engine, so it
+        rides the same compile buckets as one extra operand.
         """
         from .decode_loop import slot_chunk
         if self.sp > 1:
@@ -1000,11 +1060,17 @@ class Engine:
         if self.cache.quantized:
             raise ValueError("slot serving needs a dense KV cache "
                              "(per-row quantized writes are not wired)")
+        if self.paged and page_tables_np is None:
+            raise ValueError("paged engine: slot_step needs page_tables_np")
+        if not self.paged and page_tables_np is not None:
+            raise ValueError("page tables passed to a contiguous engine")
         t = int(tokens_np.shape[1])
         if steps < 1:
             raise ValueError("steps must be positive")
         # dynamic_update_slice clamps out-of-range starts backwards, which
-        # would silently overwrite valid history — refuse instead
+        # would silently overwrite valid history — refuse instead.  (The
+        # paged write path clamps into the scratch page rather than
+        # backwards, but the logical-position budget is the same.)
         hi = max(int(np.max(pos_rows_np)) + t,
                  int(np.max(pos_rows_np + n_valid_np)) + (steps - 1))
         if hi > self.seq_len:
@@ -1012,28 +1078,38 @@ class Engine:
                 f"slot step would write position {hi - 1} past seq_len "
                 f"{self.seq_len}; retire rows at the context edge first")
         greedy = bool(np.all(temps_np == 0.0))
-        key = ("slot", t, steps, greedy)
+        key = ("slot_paged" if self.paged else "slot", t, steps, greedy)
         fresh = key not in self._chunk_fns
         if fresh:
             cfg = self.cfg
-            self._chunk_fns[key] = jax.jit(
-                lambda p, c, tok, pr, nv, k, tm, tp: slot_chunk(
-                    p, cfg, c, tok, pr, nv, k, tm, tp,
-                    steps=steps, greedy=greedy),
-                donate_argnums=(1,),
-                out_shardings=(self._rep, self._cache_sh))
+            if self.paged:
+                self._chunk_fns[key] = jax.jit(
+                    lambda p, c, tok, pr, nv, k, tm, tp, ptab: slot_chunk(
+                        p, cfg, c, tok, pr, nv, k, tm, tp,
+                        steps=steps, greedy=greedy, page_table=ptab),
+                    donate_argnums=(1,),
+                    out_shardings=(self._rep, self._cache_sh))
+            else:
+                self._chunk_fns[key] = jax.jit(
+                    lambda p, c, tok, pr, nv, k, tm, tp: slot_chunk(
+                        p, cfg, c, tok, pr, nv, k, tm, tp,
+                        steps=steps, greedy=greedy),
+                    donate_argnums=(1,),
+                    out_shardings=(self._rep, self._cache_sh))
         self._note_executable(fresh, key=key)
         fn = self._chunk_fns[key]
         sub = jax.random.fold_in(self._key, self._chunk_counter)
         self._chunk_counter += 1
         t0 = time.perf_counter()
-        with active_mesh(self.mesh):
-            toks_dev, self.cache = fn(
-                self.params, self.cache, jnp.asarray(tokens_np, jnp.int32),
+        args = (self.params, self.cache, jnp.asarray(tokens_np, jnp.int32),
                 jnp.asarray(pos_rows_np, jnp.int32),
                 jnp.asarray(n_valid_np, jnp.int32), sub,
                 jnp.asarray(temps_np, jnp.float32),
                 jnp.asarray(topps_np, jnp.float32))
+        if self.paged:
+            args = args + (jnp.asarray(page_tables_np, jnp.int32),)
+        with active_mesh(self.mesh):
+            toks_dev, self.cache = fn(*args)
         self._sync(toks_dev, "slot step")
         t1 = time.perf_counter()
         if fresh:  # first call blocks through trace + compile
@@ -1064,6 +1140,9 @@ class Engine:
         from ..models.transformer import forward, init_kv_cache
         if self.sp > 1:
             raise ValueError("score_batch is not supported on sp meshes")
+        if self.paged:
+            raise ValueError("paged engine is slot-only; scoring needs a "
+                             "contiguous scratch cache")
         if len(sequences) != self.batch:
             raise ValueError(f"{len(sequences)} sequences for batch={self.batch}")
         if any(len(s) < 2 for s in sequences):
@@ -1184,6 +1263,9 @@ class Engine:
             raise ValueError("speculative decode is single-stream (batch=1)")
         if self.sp > 1:
             raise ValueError("speculative decode is not supported on sp meshes")
+        if self.paged:
+            raise ValueError("paged engine is slot-only; speculative decode "
+                             "uses contiguous addressing")
         steps = min(steps, self.seq_len - self.pos)
         out = list(prompt_tokens)
         # latest-occurrence n-gram index, maintained incrementally: O(1)

@@ -56,6 +56,7 @@ import numpy as np
 from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics, trace as obs_trace
 from ..obs.log import get_logger, new_request_id, request_id_var
+from .pagepool import PagePool, PagePoolExhausted, RadixTree
 
 _log = get_logger("runtime.scheduler")
 
@@ -119,7 +120,8 @@ class Ticket:
 
 
 class _Slot:
-    __slots__ = ("ticket", "pos", "fed", "produced", "last")
+    __slots__ = ("ticket", "pos", "fed", "produced", "last", "pages",
+                 "prefix_tokens", "inserted")
 
     def __init__(self):
         self.ticket: Ticket | None = None
@@ -127,6 +129,9 @@ class _Slot:
         self.fed = 0        # prompt tokens consumed so far
         self.produced = 0   # completion tokens emitted
         self.last = 0       # previous sample (decode feedback)
+        self.pages: list[int] = []   # paged mode: owned pool pages
+        self.prefix_tokens = 0       # prompt tokens bound from the radix tree
+        self.inserted = False        # prompt pages handed to the tree yet?
 
 
 class SlotScheduler:
@@ -136,7 +141,7 @@ class SlotScheduler:
 
     def __init__(self, engine, *, prefill_chunk: int = 16,
                  max_wait_ms: float = 50.0, decode_burst: int = 16,
-                 max_queue: int = 32):
+                 max_queue: int = 32, prefix_reuse: bool = True):
         if engine.sp > 1:
             raise ValueError("slot scheduling is not supported on sp meshes")
         if engine.cache.quantized:
@@ -147,6 +152,23 @@ class SlotScheduler:
         self.max_wait_ms = float(max_wait_ms)
         self.decode_burst = max(1, int(decode_burst))
         self.max_queue = max(1, int(max_queue))
+        # paged engine (engine.kv_pages > 0): the scheduler owns the page
+        # bookkeeping — a refcounted PagePool plus (prefix_reuse) a radix
+        # tree that turns repeated prompt prefixes into shared pages
+        # (runtime/pagepool.py).  Pages are reserved at admission for the
+        # whole request (prompt + budget), so a dispatch can never fail on
+        # allocation and exhaustion surfaces as queueing → 429.
+        self.paged = bool(getattr(engine, "paged", False))
+        self.pool: PagePool | None = None
+        self.prefix_cache: RadixTree | None = None
+        if self.paged:
+            self.pool = PagePool(engine.kv_pages, engine.kv_page_size)
+            if prefix_reuse:
+                self.prefix_cache = RadixTree(self.pool)
+            self._page_tables = np.zeros(
+                (engine.batch, engine.max_pages_per_slot), np.int32)
+            obs_metrics.KV_PAGES_TOTAL.set(self.pool.capacity)
+            obs_metrics.KV_PAGES_IN_USE.set(0)
         self._queue: deque[Ticket] = deque()
         self._cond = threading.Condition()
         self._draining = False
@@ -178,6 +200,17 @@ class SlotScheduler:
             raise ValueError("empty prompt")
         if max_new < 1:
             raise ValueError("max_new must be positive")
+        if self.pool is not None:
+            # a request whose full reservation exceeds the pool would wait
+            # forever — that is a sizing error, not transient saturation
+            need = min(len(prompt) + max_new, self.engine.seq_len)
+            n_pages = -(-need // self.pool.page_size)
+            if n_pages > self.pool.capacity:
+                from .engine import ContextOverflow
+                raise ContextOverflow(
+                    f"request needs {n_pages} KV pages but the pool has "
+                    f"{self.pool.capacity}; raise --kv-pages or shorten "
+                    "the request")
         t = Ticket(prompt, max_new, temperature, top_p, eos_ids, deadline)
         with self._cond:
             if self._stop or self._draining:
@@ -202,8 +235,14 @@ class SlotScheduler:
         """Live state for /health and the over-n error body."""
         with self._cond:
             active = sum(1 for s in self.slots if s.ticket is not None)
-            return {"slots": len(self.slots), "active": active,
-                    "queued": len(self._queue)}
+            out = {"slots": len(self.slots), "active": active,
+                   "queued": len(self._queue)}
+            if self.pool is not None:
+                out["kv_pages_total"] = self.pool.capacity
+                out["kv_pages_free"] = self.pool.available
+                if self.prefix_cache is not None:
+                    out["prefix_nodes"] = len(self.prefix_cache)
+            return out
 
     def begin_drain(self, deadline: float | None) -> None:
         """Stop admitting new submissions and clamp every in-flight and
@@ -252,6 +291,50 @@ class SlotScheduler:
         with self._cond:
             self._cond.notify_all()
 
+    # -- paged state snapshot/restore (runtime/snapshot.py DLSNAP02) ----
+    def snapshot_paged(self, path, extra: dict | None = None) -> str:
+        """Persist the paged serving state: the pool KV arrays ride the
+        engine snapshot, the page tables go as an extra array, and the
+        radix tree's token keys + page ids go in the JSON meta.  Call
+        with no live slots (drain or ``exclusive()`` first) — snapshots
+        of mid-flight requests are not meaningful."""
+        if self.pool is None:
+            raise ValueError("snapshot_paged on a non-paged scheduler")
+        with self._cond:
+            if self._active():
+                raise RuntimeError("snapshot_paged with live slots; "
+                                   "drain first")
+            meta = dict(extra or {})
+            meta["radix"] = (self.prefix_cache.export()
+                             if self.prefix_cache is not None else [])
+            return self.engine.snapshot(
+                path, extra=meta,
+                extra_arrays={"page_tables": self._page_tables.copy()})
+
+    def restore_paged(self, path) -> dict:
+        """Restore :meth:`snapshot_paged` state.  The engine validates
+        format/fingerprint (pool geometry is part of the fingerprint, so
+        a mismatched geometry raises SnapshotMismatch and the caller
+        cold-starts); the pool and radix tree are rebuilt from the
+        snapshot's tree keys, re-claiming their pages."""
+        if self.pool is None:
+            raise ValueError("restore_paged on a non-paged scheduler")
+        with self._cond:
+            if self._active():
+                raise RuntimeError("restore_paged with live slots")
+            extra = self.engine.restore(path)
+            arrs = getattr(self.engine, "restored_arrays", {})
+            pt = arrs.get("page_tables")
+            if pt is not None and pt.shape == self._page_tables.shape:
+                self._page_tables[:] = pt
+            self.pool = PagePool(self.engine.kv_pages,
+                                 self.engine.kv_page_size)
+            if self.prefix_cache is not None:
+                self.prefix_cache = RadixTree(self.pool)
+                self.prefix_cache.restore(extra.get("radix") or [])
+            obs_metrics.KV_PAGES_IN_USE.set(self.pool.in_use)
+            return extra
+
     # -- scheduler thread ----------------------------------------------
     def _retire(self, slot_idx: int, reason: str,
                 error: BaseException | None = None) -> None:
@@ -262,6 +345,13 @@ class SlotScheduler:
         t.finish = reason
         t.error = error
         s.ticket = None
+        if self.pool is not None and s.pages:
+            # drop this slot's references; pages the radix tree retained
+            # stay live (and reusable by the next matching prompt)
+            self.pool.decref(s.pages)
+            s.pages = []
+            self._page_tables[slot_idx][:] = 0
+            obs_metrics.KV_PAGES_IN_USE.set(self.pool.in_use)
         obs_metrics.SCHED_SLOT_RETIRES.inc(slot_idx, reason)
         now = time.monotonic()
         obs_trace.record("sched_retire", now, now, rid=t.rid, slot=slot_idx,
@@ -286,6 +376,71 @@ class SlotScheduler:
                           error=repr(error) if error is not None else None)
         t._q.put(_DONE)
 
+    def _bind_pages(self, slot_idx: int, t: Ticket) -> bool:
+        """Paged admission: match the prompt against the radix tree, then
+        reserve every page the request can ever touch (matched prefix +
+        fresh pages through ``min(len(prompt) + max_new, seq_len)``).
+        Full reservation up front is what keeps exhaustion out of the
+        dispatch path — a request that cannot get its pages stays queued
+        (False), it never fails mid-decode.  Caller holds the lock."""
+        pool = self.pool
+        ps = pool.page_size
+        prompt = t.prompt
+        matched, shared = 0, []
+        if self.prefix_cache is not None:
+            matched, shared = self.prefix_cache.match(prompt)
+            # always leave ≥1 prompt token to feed: the forward over the
+            # suffix is what produces the first sampled token.  The dropped
+            # block is re-prefilled into a fresh page; the tree keeps its
+            # copy (first writer wins on a later insert).
+            while matched >= len(prompt):
+                matched -= ps
+                shared = shared[:-1]
+        # shared pages are referenced BEFORE any allocation/eviction so the
+        # evictor (which only frees tree-only pages) cannot free a page
+        # this admission just matched
+        pool.incref(shared)
+        need_len = min(len(prompt) + t.max_new, self.engine.seq_len)
+        fresh = -(-need_len // ps) - len(shared)
+        try:
+            new_pages = pool.alloc(fresh)
+        except PagePoolExhausted:
+            new_pages = None
+            if self.prefix_cache is not None:
+                self.prefix_cache.evict(fresh - pool.available)
+                try:
+                    new_pages = pool.alloc(fresh)
+                except PagePoolExhausted:
+                    pass
+        if new_pages is None:
+            pool.decref(shared)
+            if not getattr(t, "_page_deferred", False):
+                t._page_deferred = True
+                obs_metrics.KV_POOL_EXHAUSTED.inc()
+                ctx = request_id_var.set(t.rid)
+                try:
+                    _log.info("kv pool exhausted", extra={
+                        "need_pages": fresh, "free": pool.available})
+                finally:
+                    request_id_var.reset(ctx)
+            return False
+        s = self.slots[slot_idx]
+        s.pages = list(shared) + new_pages
+        s.prefix_tokens = matched
+        s.inserted = False
+        # the slot's page-table row: reserved pages first, scratch page 0
+        # everywhere else (unreserved entries absorb overshoot writes)
+        row = self._page_tables[slot_idx]
+        row[:] = 0
+        row[:len(s.pages)] = s.pages
+        if matched:
+            obs_metrics.PREFIX_HITS.inc()
+            obs_metrics.PREFIX_TOKENS_REUSED.inc(matched)
+            obs_flight.phase(t.rid, "prefix_reuse", tokens=matched,
+                             pages=len(shared))
+        obs_metrics.KV_PAGES_IN_USE.set(pool.in_use)
+        return True
+
     def _admit_locked(self, now: float) -> None:
         """Move queued tickets into free slots (caller holds the lock)."""
         for i, s in enumerate(self.slots):
@@ -298,9 +453,18 @@ class SlotScheduler:
             if t.deadline is not None and now >= t.deadline:
                 self._fail_ticket(t, "timeout")
                 continue
+            if self.pool is not None and not self._bind_pages(i, t):
+                # pool exhausted: the ticket keeps its place at the head
+                # of the queue and admission stops for this round —
+                # retirements free pages and the next pass retries
+                self._queue.appendleft(t)
+                break
             s.ticket = t
-            s.pos = 0
-            s.fed = 0
+            # paged with a prefix hit: the matched tokens are already in
+            # the cache (shared pages), so the clock starts past them and
+            # prefill covers only the suffix.  Otherwise both start at 0
+            # (_bind_pages sets prefix_tokens; it stays 0 when contiguous).
+            s.pos = s.fed = s.prefix_tokens
             s.produced = 0
             s.last = 0
             t.slot = i
@@ -308,15 +472,18 @@ class SlotScheduler:
             obs_metrics.SCHED_SLOT_JOINS.inc(i)
             obs_trace.record("sched_admit", t.submitted_at, now, rid=t.rid,
                              slot=i, queued_ms=queued_ms,
-                             n_prompt=len(t.prompt))
+                             n_prompt=len(t.prompt),
+                             prefix_reused=s.prefix_tokens)
             ctx = request_id_var.set(t.rid)
             try:
                 _log.info("slot join", extra={
                     "slot": i, "n_prompt": len(t.prompt),
-                    "queued_ms": queued_ms})
+                    "queued_ms": queued_ms,
+                    "prefix_reused": s.prefix_tokens})
             finally:
                 request_id_var.reset(ctx)
-            obs_flight.admit(t.rid, slot=i, queued_ms=queued_ms)
+            obs_flight.admit(t.rid, slot=i, queued_ms=queued_ms,
+                             prefix_reused=s.prefix_tokens)
             obs_metrics.QUEUE_WAIT.observe(max(now - t.submitted_at, 0.0))
 
     def _active(self) -> list[int]:
@@ -475,7 +642,9 @@ class SlotScheduler:
         error = None
         try:
             out = eng.slot_step(tokens, pos_rows, n_valid,
-                                temps_np=temps, topps_np=topps, steps=steps)
+                                temps_np=temps, topps_np=topps, steps=steps,
+                                page_tables_np=self._page_tables
+                                if self.paged else None)
         except Exception as e:
             error = e
         tp1 = time.perf_counter()
@@ -527,7 +696,17 @@ class SlotScheduler:
                     if s.fed < len(t.prompt):
                         continue  # mid-prefill: sample not meaningful yet
                     # prefill just completed: this sample IS the first
-                    # completion token — fall through to emit it
+                    # completion token — fall through to emit it.  The
+                    # prompt's full pages are now entirely written and will
+                    # never be rewritten (the clock only moves forward), so
+                    # this is the moment they become shareable.
+                    if self.prefix_cache is not None and not s.inserted:
+                        s.inserted = True
+                        ps = self.pool.page_size
+                        n_full = len(t.prompt) // ps
+                        if n_full:
+                            self.prefix_cache.insert(
+                                t.prompt[:n_full * ps], s.pages[:n_full])
                 else:
                     s.pos += 1
                 s.last = tok

@@ -11,7 +11,7 @@ this).
 
 File layout (little-endian)::
 
-    8 B   magic   b"DLSNAP01"
+    8 B   magic   b"DLSNAP02"
     4 B   u32     meta_len
     4 B   u32     crc32(meta || payload)
     meta_len B    meta JSON
@@ -46,7 +46,13 @@ from ..obs.log import get_logger
 
 _log = get_logger("runtime.snapshot")
 
-MAGIC = b"DLSNAP01"
+MAGIC = b"DLSNAP02"
+# DLSNAP01 lacked the paged-KV state (page pool geometry in the
+# fingerprint, page tables + radix-tree keys in the extras); restoring
+# one silently would resurrect a contiguous cache under a paged engine.
+# Old files are recognized and refused with a distinct message so the
+# caller's cold-start fallback logs *why* rather than "corrupt".
+_LEGACY_MAGICS = (b"DLSNAP01",)
 _HEADER = struct.Struct("<8sII")  # magic, meta_len, crc32(meta || payload)
 _MAX_META = 1 << 24
 
@@ -123,6 +129,12 @@ def load(path: str | os.PathLike) -> tuple[dict, dict[str, np.ndarray]]:
                                 expected=f"{_HEADER.size} bytes",
                                 got=f"{len(head)} bytes")
         magic, meta_len, crc_want = _HEADER.unpack(head)
+        if magic in _LEGACY_MAGICS:
+            raise ArtifactError(path, "magic",
+                                "superseded snapshot format — this build "
+                                "writes DLSNAP02 (paged-KV state); delete "
+                                "the old snapshot and cold-start",
+                                offset=0, expected=MAGIC, got=magic)
         if magic != MAGIC:
             raise ArtifactError(path, "magic", "not a dllama snapshot",
                                 offset=0, expected=MAGIC, got=magic)

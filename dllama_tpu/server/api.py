@@ -403,11 +403,21 @@ class ApiState:
             return None
         return os.path.join(self.snapshot_dir, "engine.snap")
 
+    @property
+    def sched_snapshot_path(self) -> str | None:
+        if not self.snapshot_dir:
+            return None
+        return os.path.join(self.snapshot_dir, "scheduler.snap")
+
     def save_snapshot(self) -> str | None:
         """Snapshot the chat engine's state + the conversation cache to
         ``--snapshot-dir`` (called after drain, when no request holds the
         engine).  Returns the path, or None when disabled/failed — a
-        snapshot failure must never turn a clean drain into a crash."""
+        snapshot failure must never turn a clean drain into a crash.
+
+        A paged scheduler gets a sibling file: its pool KV, page tables
+        and radix-tree keys (SlotScheduler.snapshot_paged), so the prefix
+        cache built up before the drain survives the restart warm."""
         path = self.snapshot_path
         if path is None:
             return None
@@ -421,11 +431,21 @@ class ApiState:
                     self.engine.snapshot(path,
                                          extra={"naive_cache": cache_items})
             _log.info("snapshot_saved", extra={"path": path})
-            return path
         except Exception as e:
             _log.warning("snapshot_save_failed", extra={
                 "path": path, "error": str(e)})
             return None
+        if self.scheduler is not None and self.scheduler.pool is not None:
+            try:
+                self.scheduler.snapshot_paged(self.sched_snapshot_path)
+                _log.info("sched_snapshot_saved",
+                          extra={"path": self.sched_snapshot_path})
+            except Exception as e:
+                # best-effort: the prefix cache is a performance artifact,
+                # losing it only costs re-prefills after restart
+                _log.warning("sched_snapshot_save_failed", extra={
+                    "path": self.sched_snapshot_path, "error": str(e)})
+        return path
 
     def restore_snapshot(self) -> bool:
         """Warm-boot from ``--snapshot-dir`` when a snapshot exists.
@@ -461,6 +481,23 @@ class ApiState:
         _log.info("warm_start", extra={
             "path": path, "pos": self.engine.pos,
             "cached_messages": len(self.naive_cache.items)})
+        spath = self.sched_snapshot_path
+        if (self.scheduler is not None and self.scheduler.pool is not None
+                and spath and os.path.exists(spath)):
+            try:
+                self.scheduler.restore_paged(spath)
+                _log.info("sched_warm_start", extra={
+                    "path": spath,
+                    "prefix_nodes": len(self.scheduler.prefix_cache or ())})
+            except Exception as e:
+                # stale/mismatched scheduler state (geometry change,
+                # superseded format): cold pool, warm everything else
+                _log.warning("sched_snapshot_rejected_cold_start", extra={
+                    "path": spath, "error": str(e)})
+            try:
+                os.remove(spath)
+            except OSError:
+                pass
         return True
 
     def retry_after_hint(self) -> int:
@@ -645,6 +682,14 @@ class ApiState:
         eng = self.batch_engine
         if eng is None:
             raise ValueError("batched serving not enabled (--batch-slots)")
+        if getattr(eng, "paged", False):
+            # the paged pool has no whole-batch reset/lockstep mode
+            # (engine.slot_step is the only entry); these requests must go
+            # one at a time through the scheduler instead
+            raise ContextOverflow(
+                "prompt lists, n>1 and logprobs are not available with "
+                "--kv-pages (slot scheduling only); send requests "
+                "individually")
         n_real = len(id_lists)
         if not (0 < n_real <= eng.batch):
             raise ContextOverflow(
@@ -1678,11 +1723,17 @@ def make_handler(state: ApiState):
 
         def _chat_sched(self, body: dict, deadline: float | None,
                         timer: _StreamTimer | None = None):
-            """Chat spillover path: a second concurrent conversation
-            joins a batch slot instead of queueing on the engine mutex.
-            The NaiveCache is neither consulted nor updated — the slot
-            engine prefills the full templated history (prefix-resume
-            stays a mutex-path feature)."""
+            """Chat over the slot scheduler.  Without prefix reuse this
+            is the spillover path (a second concurrent conversation joins
+            a batch slot instead of queueing on the engine mutex) and the
+            slot engine re-prefills the full templated history each turn.
+            With the paged radix cache it is the PRIMARY chat path: the
+            scheduler matches the templated history against the tree at
+            admission, binds the already-cached prefix pages copy-free,
+            and prefills only the new suffix — the NaiveCache's
+            prefix-resume win, but shared across conversations and
+            requiring no mutex.  The NaiveCache itself is neither
+            consulted nor updated here."""
             try:
                 params = parse_request(body, state.default_temperature,
                                        state.default_topp)
@@ -1815,6 +1866,15 @@ def make_handler(state: ApiState):
                 use_sched = False
                 if self._sched_eligible(body):
                     if self.path == "/v1/completions":
+                        use_sched = True
+                    elif state.scheduler.prefix_cache is not None:
+                        # paged scheduler with a radix prefix cache: chat
+                        # always rides a slot — repeated system prompts and
+                        # growing conversation histories match the tree and
+                        # bind shared pages copy-free, which beats the
+                        # mutex path's single-conversation NaiveCache (and
+                        # the old spillover behavior of re-prefilling the
+                        # full history on every contended request)
                         use_sched = True
                     else:
                         # chat spillover: the mutex path keeps the
@@ -2120,12 +2180,18 @@ def main(argv=None):
     if args.batch_slots > 0:
         # share the chat engine's placed weights; only a new KV cache is
         # allocated (see ApiState docstring)
+        if args.kv_pages > 0 and engine.cache.quantized:
+            raise SystemExit("--kv-pages needs a dense KV cache; drop "
+                             "--kv-cache-dtype q8")
         batch_engine = Engine(engine.cfg, engine.params, mesh=engine.mesh,
                               batch=args.batch_slots, seq_len=args.max_seq_len,
                               kv_dtype=engine.cache.k.dtype,
-                              step_timeout=args.step_timeout)
+                              step_timeout=args.step_timeout,
+                              kv_pages=args.kv_pages,
+                              kv_page_size=args.kv_page_size)
         _log.info("batch_serving_enabled",
-                  extra={"slots": args.batch_slots})
+                  extra={"slots": args.batch_slots,
+                         "kv_pages": args.kv_pages})
         try:
             # tentpole: continuous batching — single-stream requests join
             # the batch engine at decode-step granularity instead of
@@ -2133,11 +2199,15 @@ def main(argv=None):
             # path for seeded sampling, logprobs, echo, and n>1)
             scheduler = SlotScheduler(
                 batch_engine, prefill_chunk=args.sched_prefill_chunk,
-                max_wait_ms=args.sched_max_wait_ms)
+                max_wait_ms=args.sched_max_wait_ms,
+                max_queue=args.sched_max_queue,
+                prefix_reuse=not args.no_prefix_reuse)
             _log.info("slot_scheduler_enabled", extra={
                 "slots": args.batch_slots,
                 "prefill_chunk": args.sched_prefill_chunk,
-                "max_wait_ms": args.sched_max_wait_ms})
+                "max_wait_ms": args.sched_max_wait_ms,
+                "paged": scheduler.paged,
+                "prefix_reuse": scheduler.prefix_cache is not None})
         except ValueError as e:
             # quantized KV / sp mesh: lockstep batch serving still works,
             # only decode-step admission is off

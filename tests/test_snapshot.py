@@ -225,3 +225,84 @@ def test_server_restore_snapshot_cold_start_paths(tmp_path):
     assert state3.restore_snapshot() is False
     assert eng3.pos == 0
     assert os.path.exists(state3.snapshot_path)
+
+
+# -- DLSNAP02: paged-KV state ----------------------------------------------
+
+def test_legacy_dlsnap01_magic_rejected(tmp_path):
+    """A DLSNAP01-era file is refused with a 'superseded format' error
+    (an ArtifactError, so the server's restore path cold-starts exactly
+    like the corrupt-file case — with a reason that says why)."""
+    path = str(tmp_path / "engine.snap")
+    e = make_engine()
+    e.snapshot(path)
+    data = bytearray(open(path, "rb").read())
+    assert data[:8] == b"DLSNAP02"
+    data[:8] = b"DLSNAP01"  # the header crc covers meta+payload, not magic
+    old = str(tmp_path / "old.snap")
+    with open(old, "wb") as f:
+        f.write(bytes(data))
+    with pytest.raises(ArtifactError, match="superseded"):
+        snapfmt.load(old)
+    with pytest.raises(ArtifactError, match="superseded"):
+        make_engine().restore(old)
+
+
+def make_paged_stack(kv_pages=17, page=8, batch=2, prefix_reuse=True):
+    from dllama_tpu.runtime.scheduler import SlotScheduler
+    eng = make_engine(batch=batch, kv_pages=kv_pages, kv_page_size=page)
+    return eng, SlotScheduler(eng, prefill_chunk=4,
+                              prefix_reuse=prefix_reuse)
+
+
+def test_paged_scheduler_snapshot_roundtrip(tmp_path):
+    """snapshot_paged persists the pool KV, page tables, and the radix
+    tree's token keys; restore_paged rebuilds them so a prompt that
+    matched the tree before the restart still matches after it — and the
+    reused decode is byte-identical to the pre-restart one."""
+    from dllama_tpu.obs import metrics as obs_metrics
+    path = str(tmp_path / "sched.snap")
+    prompt = list(range(1, 18))  # two full 8-token blocks + a suffix
+    eng1, sched1 = make_paged_stack()
+    try:
+        t = sched1.submit(prompt, 8, temperature=0.0)
+        ref = list(t.tokens())
+        assert len(sched1.prefix_cache) == 2
+        sched1.snapshot_paged(path, extra={"note": "pre-restart"})
+    finally:
+        sched1.close()
+
+    eng2, sched2 = make_paged_stack()
+    try:
+        extra = sched2.restore_paged(path)
+        assert extra["note"] == "pre-restart"
+        assert len(sched2.prefix_cache) == 2
+        assert sched2.pool.in_use == 2
+        sched2.pool.check()
+        reused0 = obs_metrics.PREFIX_TOKENS_REUSED.value
+        t = sched2.submit(prompt, 8, temperature=0.0)
+        out = list(t.tokens())
+        # the restored tree (and restored pool KV) served the prefix
+        assert obs_metrics.PREFIX_TOKENS_REUSED.value - reused0 == 16
+        assert out == ref
+    finally:
+        sched2.close()
+
+
+def test_paged_pool_geometry_mismatch(tmp_path):
+    """Pool geometry rides the config fingerprint: a snapshot from a
+    different page count or size is refused with SnapshotMismatch and the
+    scheduler cold-starts untouched."""
+    path = str(tmp_path / "sched.snap")
+    eng1, sched1 = make_paged_stack(kv_pages=17)
+    try:
+        sched1.snapshot_paged(path)
+    finally:
+        sched1.close()
+    for kw in ({"kv_pages": 9}, {"kv_pages": 34, "page": 4}):
+        eng2, sched2 = make_paged_stack(**kw)
+        try:
+            with pytest.raises(SnapshotMismatch):
+                sched2.restore_paged(path)
+        finally:
+            sched2.close()

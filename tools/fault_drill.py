@@ -375,6 +375,75 @@ def drill_slot_churn(model, tok):
         s.stop()
 
 
+def drill_page_exhaustion(model, tok):
+    """A paged KV pool sized for ~one request at a time: concurrent
+    requests exhaust the pool, the overflow defers (queue) rather than
+    erroring, submissions past the queue bound get 429, and retirements
+    free the pages so every admitted request completes — no leak."""
+    # seq_len 64 / page 4 → 16 pages/slot max; --kv-pages 16 gives 15
+    # usable pages, and a max_tokens=48 request reserves ~13 of them, so
+    # a second concurrent request cannot bind and waits for pages.
+    # --no-prefix-reuse keeps the accounting exact (nothing retained).
+    s = Server(model, tok, faults="engine.device_step=delay:0.2",
+               extra_flags=["--batch-slots", "2", "--kv-pages", "16",
+                            "--kv-page-size", "4", "--sched-max-queue", "1",
+                            "--no-prefix-reuse"])
+    try:
+        s.wait_ready()
+        occ = get(s.base, "/health")["scheduler"]
+        assert occ["kv_pages_total"] == 15, occ
+        comp = {"prompt": "hello", "max_tokens": 48}
+        results: list = []
+
+        def run():
+            with post_to(s.base, "/v1/completions", comp) as r:
+                results.append(json.loads(r.read()))
+
+        t1 = threading.Thread(target=run)
+        t1.start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:  # wait until it holds its pages
+            occ = get(s.base, "/health")["scheduler"]
+            if occ["active"] >= 1:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("first request never became active")
+        # these two cannot get pages: they defer in the queue (a free slot
+        # exists — exhaustion must surface as queueing, not engine errors)
+        t2 = threading.Thread(target=run)
+        t2.start()
+        t3 = threading.Thread(target=run)
+        t3.start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if get(s.base, "/metrics").get("kv_pool_exhausted", 0) >= 1 \
+                    and get(s.base, "/health")["scheduler"]["queued"] >= 2:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("pool exhaustion was never recorded")
+        # the queue is now at its bound: the next submission is refused
+        # with the same 429 + Retry-After contract as mutex backpressure
+        try:
+            post_to(s.base, "/v1/completions", dict(comp, max_tokens=2))
+            raise AssertionError("expected 429 past the queue bound")
+        except urllib.error.HTTPError as e:
+            assert e.code == 429, e.code
+            assert int(e.headers["Retry-After"]) >= 1
+        # retirement frees pages: every deferred request binds and serves
+        for t in (t1, t2, t3):
+            t.join(300)
+        assert len(results) == 3, f"only {len(results)}/3 served"
+        for d in results:
+            assert d["choices"][0]["finish_reason"] in ("stop", "length"), d
+        occ = get(s.base, "/health")["scheduler"]
+        assert occ["active"] == 0 and occ["queued"] == 0, occ
+        assert occ["kv_pages_free"] == 15, f"page leak: {occ}"
+    finally:
+        s.stop()
+
+
 def drill_slo_burn(model, tok):
     """An injected per-dispatch delay burns the ITL error budget: /health
     flips to violating with slo_violations_total >= 1, then recovers to
@@ -430,6 +499,7 @@ DRILLS = {
     "snapshot_restart": drill_snapshot_restart,
     "latency_histogram": drill_latency_histogram,
     "slot_churn": drill_slot_churn,
+    "page_exhaustion": drill_page_exhaustion,
     "slo_burn": drill_slo_burn,
 }
 
