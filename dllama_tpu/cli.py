@@ -53,7 +53,7 @@ DTYPES = {"f32": "float32", "bf16": "bfloat16", "f16": "float16"}
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dllama", description=__doc__)
     p.add_argument("mode", choices=["inference", "generate", "chat", "worker",
-                                    "batch"])
+                                    "batch", "router"])
     p.add_argument("--model", help="path to .m model file")
     p.add_argument("--tokenizer", help="path to .t tokenizer file")
     p.add_argument("--prompt", default=None)
@@ -223,6 +223,38 @@ def build_parser() -> argparse.ArgumentParser:
                         "here and the next boot warm-starts from it "
                         "(validated: a corrupt or mismatched snapshot "
                         "cold-starts with a logged reason)")
+    p.add_argument("--handoff", action="store_true",
+                   help="api server: on SIGTERM drain, export each in-flight "
+                        "scheduler request as a per-request DLREQ01 hand-off "
+                        "record (KV pages + decode state) fetchable via "
+                        "/admin/export/<rid>, and accept records from peers "
+                        "at /admin/import — the fleet router migrates "
+                        "requests between replicas with these during a "
+                        "rolling restart (docs/SERVING.md).  Requires the "
+                        "paged scheduler (--batch-slots + --kv-pages)")
+    # ---- fleet router (router/ package; docs/SERVING.md) ----
+    p.add_argument("--backends", default=None,
+                   help="router mode: comma-separated replica addresses "
+                        "(host:port,...) fronted by this router; each must "
+                        "be a dllama-api server")
+    p.add_argument("--probe-interval", type=float, default=2.0,
+                   help="router mode: seconds between /health probes of "
+                        "each backend")
+    p.add_argument("--eject-after", type=int, default=3,
+                   help="router mode: consecutive probe/dispatch failures "
+                        "before a backend is ejected from dispatch")
+    p.add_argument("--readmit-after", type=int, default=2,
+                   help="router mode: consecutive successful probes before "
+                        "an ejected backend is re-admitted (hysteresis: "
+                        "one lucky probe does not un-eject)")
+    p.add_argument("--router-retries", type=int, default=2,
+                   help="router mode: max re-dispatches of a request to "
+                        "another backend when one fails before any "
+                        "response bytes were forwarded")
+    p.add_argument("--upstream-timeout", type=float, default=120.0,
+                   help="router mode: socket timeout per upstream request "
+                        "(connect + per-read); a backend silent past this "
+                        "is treated as failed")
     # ---- observability (docs/OBSERVABILITY.md) ----
     p.add_argument("--log-format", choices=["human", "json"], default=None,
                    help="log output format: human-readable lines or JSON "
@@ -546,6 +578,13 @@ def cmd_worker(args) -> None:
     WORKER_PROGRAMS[args.program](args)
 
 
+def cmd_router(args) -> None:
+    """Fleet router: front N dllama-api replicas (router/ package; no
+    model or jax in this process — it only proxies HTTP)."""
+    from .router.service import main as router_main
+    router_main(args)
+
+
 # One table drives the --program choices AND the worker dispatch, so a
 # new mirrored program cannot be added to one and missed in the other
 # (chat stays out: interactive, single-host only).
@@ -573,7 +612,8 @@ def main(argv=None) -> None:
     if args.coordinator or distributed_env() is not None:
         init_distributed(args.coordinator, args.nproc, args.proc_id)
     {"inference": cmd_inference, "generate": cmd_generate,
-     "chat": cmd_chat, "worker": cmd_worker, "batch": cmd_batch}[args.mode](args)
+     "chat": cmd_chat, "worker": cmd_worker, "batch": cmd_batch,
+     "router": cmd_router}[args.mode](args)
 
 
 if __name__ == "__main__":

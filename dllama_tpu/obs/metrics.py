@@ -635,3 +635,50 @@ SLO_VIOLATIONS = REGISTRY.labeled_counter(
     "slo_violations", ("objective",),
     "Transitions of an objective into the violating state (all windows "
     "burning >= 1.0) since process start.")
+
+# per-request KV hand-off (runtime/scheduler.py export/import seam +
+# server /admin/export/<rid> and /admin/import).  A draining replica
+# exports each active slot as a DLREQ01 record; the router re-binds it
+# on a geometry-compatible peer so decode resumes without re-prefill.
+HANDOFF_EXPORTS = REGISTRY.counter(
+    "handoff_exports",
+    "Hand-off records fetched from this replica via /admin/export "
+    "(one per drained in-flight request picked up by the router).")
+HANDOFF_IMPORTS = REGISTRY.counter(
+    "handoff_imports",
+    "Hand-off records accepted via /admin/import and resumed in a "
+    "local batch slot.")
+HANDOFF_IMPORT_REJECTS = REGISTRY.counter(
+    "handoff_import_rejects",
+    "Hand-off records refused at /admin/import (geometry fingerprint "
+    "mismatch or corrupt/invalid record).")
+
+# fleet router (router/ package — a separate process; these families
+# are exported by the *router's* /metrics, not a replica's).  Dispatch,
+# retry, ejection, and hand-off counters quantify the rolling-restart
+# story: a healthy fleet drains with handoffs>0 and replica_lost==0.
+ROUTER_DISPATCH = REGISTRY.labeled_counter(
+    "router_dispatch", ("backend",),
+    "Requests dispatched to each backend replica.")
+ROUTER_RETRIES = REGISTRY.counter(
+    "router_retries",
+    "Requests re-dispatched to another replica after a backend failed "
+    "before any response bytes reached the client.")
+ROUTER_EJECTIONS = REGISTRY.labeled_counter(
+    "router_ejections", ("backend",),
+    "Backend transitions into the ejected state (probe/dispatch "
+    "failure streak reached the ejection threshold).")
+ROUTER_READMITS = REGISTRY.labeled_counter(
+    "router_readmits", ("backend",),
+    "Ejected backends re-admitted after consecutive successful probes.")
+ROUTER_HANDOFFS = REGISTRY.counter(
+    "router_handoffs",
+    "In-flight requests migrated between replicas via KV hand-off "
+    "(export from a draining backend, import on a peer).")
+ROUTER_REPLICA_LOST = REGISTRY.counter(
+    "router_replica_lost",
+    "Streaming requests finished with finish_reason=replica_lost "
+    "because their backend died after response bytes were sent.")
+ROUTER_BACKEND_LATENCY_S = REGISTRY.labeled_gauge(
+    "router_backend_latency_s", ("backend",),
+    "EWMA of health-probe round-trip latency per backend, seconds.")

@@ -1,0 +1,26 @@
+"""Fleet router: front N ``dllama-api`` replicas with one OpenAI surface.
+
+The reference's topology is a single root process that owns admission,
+sampling, and the residual stream — one process is the whole service.
+This package is the step past that: a standalone router process
+(``python -m dllama_tpu.router --backends host:port,...``) that
+
+* probes each replica's ``/health`` and scores it on the machine-
+  readable ``capacity`` block (free slots, free KV pages, queue depth,
+  degraded flag, SLO verdict) — :mod:`.registry`;
+* dispatches each request to the least-loaded healthy replica, with
+  hysteretic ejection after consecutive failures and re-admission after
+  consecutive healthy probes;
+* retries a request on another replica when a backend dies before any
+  response bytes were forwarded, and finishes the stream with
+  ``finish_reason="replica_lost"`` when it dies after;
+* migrates in-flight requests off a draining replica via per-request
+  DLREQ01 KV hand-off records (``/admin/export`` → ``/admin/import``),
+  so ``SIGTERM``-one-replica is a zero-error rolling restart —
+  :mod:`.service`.
+
+The router is pure stdlib HTTP plumbing: no jax, no model, no
+tokenizer.  It reuses the obs stack (flight recorder, metric registry)
+in its own process, so ``/debug/requests`` and ``/metrics`` work the
+same way here as on a replica.  See docs/SERVING.md.
+"""

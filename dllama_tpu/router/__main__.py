@@ -1,0 +1,48 @@
+"""``python -m dllama_tpu.router`` — start the fleet router without
+importing the model/jax stack (the full CLI's ``dllama router`` mode
+works too; this entry point is what deploy scripts and the fault drills
+use because it starts in milliseconds)."""
+
+import argparse
+
+from ..obs import flight as obs_flight
+from ..obs.log import configure as configure_logging
+from .service import main as service_main
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m dllama_tpu.router",
+        description="Fleet router fronting N dllama-api replicas "
+                    "(docs/SERVING.md)")
+    p.add_argument("--backends", required=True,
+                   help="comma-separated replica addresses (host:port,...)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9990)
+    p.add_argument("--probe-interval", type=float, default=2.0,
+                   help="seconds between /health probes per backend")
+    p.add_argument("--eject-after", type=int, default=3,
+                   help="consecutive failures before ejection")
+    p.add_argument("--readmit-after", type=int, default=2,
+                   help="consecutive healthy probes before re-admission")
+    p.add_argument("--router-retries", type=int, default=2,
+                   help="max re-dispatches before giving up on a request")
+    p.add_argument("--upstream-timeout", type=float, default=120.0,
+                   help="socket timeout per upstream request (seconds)")
+    p.add_argument("--log-format", choices=["human", "json"], default=None)
+    p.add_argument("--log-level", default=None,
+                   choices=["debug", "info", "warning", "error"])
+    p.add_argument("--flight-buffer", type=int, default=None,
+                   help="router-side flight ring capacity")
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    configure_logging(args.log_format, args.log_level)
+    obs_flight.configure(args.flight_buffer)
+    service_main(args)
+
+
+if __name__ == "__main__":
+    main()

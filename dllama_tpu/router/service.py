@@ -1,0 +1,733 @@
+"""Router HTTP service: OpenAI-surface proxy over the replica registry.
+
+One ``ThreadingHTTPServer`` (stdlib only — the router carries no model,
+no tokenizer, no jax) that fronts N ``dllama-api`` replicas:
+
+* ``POST /v1/completions`` and ``/v1/chat/completions`` dispatch to the
+  least-loaded healthy replica (:mod:`.registry`), stamping
+  ``X-Request-Id`` (the fleet-wide correlation id — the replica's
+  flight record and hand-off record both key on it) and
+  ``X-Dllama-Hop`` (this router's instance id) on the upstream hop.
+* A backend that fails **before any response bytes were forwarded** is
+  retried on another replica — the request was idempotent up to that
+  point.  A backend that dies **mid-stream** ends the client's stream
+  with a final ``finish_reason="replica_lost"`` chunk: the truncation
+  is flagged, never silent.
+* A replica that begins draining finishes each in-flight scheduler
+  request with the internal ``finish_reason="handoff"``.  The router
+  intercepts it (never forwarded), fetches the request's DLREQ01 record
+  from ``/admin/export/<rid>``, offers it to geometry-compatible peers
+  via ``/admin/import?emitted_chars=N``, and splices the peer's
+  continuation into the client's still-open stream — the client sees
+  one seamless completion across the replica move.  A request that had
+  produced no client-visible bytes yet (e.g. it was still queued) falls
+  back to a plain full retry.
+* ``GET /health`` is the fleet aggregate, ``/metrics`` the router's own
+  registry (router_* families), ``/debug/requests`` the router-side
+  flight ring — same observability surface as a replica, one process up.
+
+See docs/SERVING.md for the topology and the rolling-restart runbook.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import re
+import signal
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
+
+from ..obs import flight as obs_flight, metrics as obs_metrics
+from ..obs.log import get_logger, set_request_id
+from .registry import Backend, Registry
+
+_log = get_logger("router.service")
+
+_RID_RE = re.compile(r"[^A-Za-z0-9._-]")
+_RID_MAX = 64
+MAX_BODY_BYTES = 1 << 20
+
+
+def _iter_sse(resp):
+    """Yield the payload of each ``data:`` line from an SSE response.
+
+    Our servers emit exactly one ``data: ...`` line per event, so
+    per-line is per-event; blank separator lines are skipped."""
+    while True:
+        line = resp.readline()
+        if not line:
+            return
+        line = line.rstrip(b"\r\n")
+        if line.startswith(b"data: "):
+            yield line[len(b"data: "):]
+
+
+def _evt_fields(evt: dict, chat: bool) -> tuple[str, str | None]:
+    """(delta_text, finish_reason) of one upstream SSE event."""
+    choice = (evt.get("choices") or [{}])[0]
+    if chat:
+        text = (choice.get("delta") or {}).get("content") or ""
+    else:
+        text = choice.get("text") or ""
+    return text, choice.get("finish_reason")
+
+
+class RouterState:
+    def __init__(self, registry: Registry, *, retries: int = 2,
+                 upstream_timeout: float = 120.0,
+                 model_name: str = "fleet"):
+        self.registry = registry
+        self.retries = max(0, int(retries))
+        self.upstream_timeout = float(upstream_timeout)
+        self.model_name = model_name
+        # hop id: correlates every replica-side flight record this
+        # router created (X-Dllama-Hop) with this process
+        self.hop = f"router-{uuid.uuid4().hex[:8]}"
+        self.started_at = time.time()
+
+    def connect(self, b: Backend) -> http.client.HTTPConnection:
+        return http.client.HTTPConnection(b.host, b.port,
+                                          timeout=self.upstream_timeout)
+
+    def health(self) -> dict:
+        snap = self.registry.snapshot()
+        return {
+            "status": "ok" if snap["available"] else "unavailable",
+            "ready": snap["available"] > 0,
+            "role": "router",
+            "hop": self.hop,
+            "model": self.model_name,
+            "uptime_s": round(time.time() - self.started_at, 3),
+            **snap,
+        }
+
+
+class _Ctx:
+    """Per-request forwarding state shared across dispatch attempts."""
+
+    def __init__(self):
+        self.chars = 0            # completion-text chars forwarded
+        self.headers_sent = False  # client SSE headers committed
+        self.client_gone = False
+        self.finished = False      # a finish_reason reached the client
+        self.busy = None           # last (status, body, retry_after)
+        self.cid = None            # id/model/created of the first
+        self.model = None          # upstream chunk — reused when the
+        self.created = None        # router must fabricate chunks
+
+
+def make_handler(state: RouterState):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = "dllama-router"
+
+        def log_message(self, fmt, *args):  # route through our logger
+            _log.debug("%s " + fmt, self.client_address[0], *args)
+
+        # -- plumbing --------------------------------------------------
+        def _json(self, code: int, obj: dict, headers=()) -> None:
+            data = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.send_header("X-Request-Id", getattr(self, "_rid", "") or "")
+            for k, v in headers:
+                self.send_header(k, v)
+            self.end_headers()
+            try:
+                self.wfile.write(data)
+            except OSError:
+                pass
+
+        def _relay(self, code: int, data: bytes, ctype: str | None,
+                   headers=()) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type",
+                             ctype or "application/octet-stream")
+            self.send_header("Content-Length", str(len(data)))
+            self.send_header("X-Request-Id", getattr(self, "_rid", "") or "")
+            for k, v in headers:
+                if v:
+                    self.send_header(k, v)
+            self.end_headers()
+            try:
+                self.wfile.write(data)
+            except OSError:
+                pass
+
+        def _sse_headers(self, ctx: _Ctx) -> None:
+            if ctx.headers_sent:
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.send_header("X-Request-Id", self._rid)
+            self.end_headers()
+            ctx.headers_sent = True
+
+        def _client_event(self, ctx: _Ctx, payload: bytes) -> bool:
+            if ctx.client_gone:
+                return False
+            try:
+                self.wfile.write(b"data: " + payload + b"\n\n")
+                self.wfile.flush()
+                return True
+            except OSError:
+                ctx.client_gone = True
+                return False
+
+        def _client_chunk(self, ctx: _Ctx, chat: bool, text: str,
+                          finish: str | None) -> None:
+            """Fabricate a chunk in the client's endpoint shape (used
+            for hand-off continuations and replica_lost finishes)."""
+            if chat:
+                if text:
+                    self._client_event(ctx, json.dumps({
+                        "id": ctx.cid, "object": "chat.completion.chunk",
+                        "created": ctx.created, "model": ctx.model,
+                        "choices": [{"index": 0,
+                                     "delta": {"content": text},
+                                     "finish_reason": None}]}).encode())
+                if finish is not None:
+                    self._client_event(ctx, json.dumps({
+                        "id": ctx.cid, "object": "chat.completion.chunk",
+                        "created": ctx.created, "model": ctx.model,
+                        "choices": [{"index": 0, "delta": {},
+                                     "finish_reason": finish}]}).encode())
+            else:
+                self._client_event(ctx, json.dumps({
+                    "id": ctx.cid, "object": "text_completion",
+                    "created": ctx.created, "model": ctx.model,
+                    "choices": [{"text": text, "index": 0,
+                                 "finish_reason": finish,
+                                 "logprobs": None}]}).encode())
+            if text:
+                ctx.chars += len(text)
+            if finish is not None:
+                ctx.finished = True
+
+        # -- GET surface -----------------------------------------------
+        def do_GET(self):
+            self._rid = _RID_RE.sub(
+                "", self.headers.get("X-Request-Id") or "")[:_RID_MAX] \
+                or uuid.uuid4().hex[:16]
+            path, _, query = self.path.partition("?")
+            if path in ("/health", "/healthz"):
+                self._json(200, state.health())
+            elif path == "/metrics":
+                q = parse_qs(query)
+                accept = self.headers.get("Accept") or ""
+                if (q.get("format", [""])[0] == "prometheus"
+                        or "text/plain" in accept or "openmetrics" in accept):
+                    data = obs_metrics.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                else:
+                    self._json(200, obs_metrics.snapshot_json())
+            elif path == "/debug/requests":
+                try:
+                    n = int(q[0]) if (q := parse_qs(query).get("n")) else 50
+                except ValueError:
+                    n = 50
+                self._json(200, {"requests": obs_flight.recent(n)})
+            elif path.startswith("/debug/requests/"):
+                rid = path[len("/debug/requests/"):]
+                rec = obs_flight.get(rid)
+                if rec is None:
+                    self._json(404, {"error": f"no flight record for "
+                                              f"request id {rid!r}"})
+                else:
+                    self._json(200, rec)
+            elif path == "/v1/models":
+                self._proxy_models()
+            else:
+                self._json(404, {"error": f"unknown path {path}"})
+
+        def _proxy_models(self):
+            b = state.registry.pick()
+            if b is None:
+                self._json(503, {"error": "no backend available"},
+                           headers=[("Retry-After", "5")])
+                return
+            try:
+                conn = state.connect(b)
+                try:
+                    conn.request("GET", "/v1/models")
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    self._relay(resp.status, data,
+                                resp.getheader("Content-Type"))
+                finally:
+                    conn.close()
+            except OSError:
+                state.registry.record_failure(b)
+                self._json(502, {"error": f"backend {b.addr} unreachable"})
+
+        # -- POST surface ----------------------------------------------
+        def do_POST(self):
+            path = self.path.partition("?")[0]
+            if path not in ("/v1/completions", "/v1/chat/completions"):
+                self._json(404, {"error": f"unknown path {path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                self._json(400, {"error": "bad Content-Length"})
+                return
+            if length <= 0:
+                self._json(400, {"error": "request body required"})
+                return
+            if length > MAX_BODY_BYTES:
+                self._json(413, {"error": "request body too large"})
+                return
+            try:
+                raw = self.rfile.read(length)
+            except OSError:
+                return
+            try:
+                body = json.loads(raw)
+            except ValueError as e:
+                self._json(400, {"error": f"bad JSON: {e}"})
+                return
+            self._rid = _RID_RE.sub(
+                "", self.headers.get("X-Request-Id") or "")[:_RID_MAX] \
+                or uuid.uuid4().hex[:16]
+            set_request_id(self._rid)
+            self._proxy_completion(path, raw, body)
+
+        def _proxy_completion(self, path: str, raw: bytes,
+                              body: dict) -> None:
+            chat = path == "/v1/chat/completions"
+            stream = bool(body.get("stream"))
+            rid = self._rid
+            obs_flight.submit(rid, path=path, stream=stream, hop=state.hop)
+            ctx = _Ctx()
+            tried: list[Backend] = []
+            retries_left = state.retries
+            while True:
+                b = state.registry.pick(exclude=tried)
+                if b is None:
+                    self._out_of_backends(ctx, chat, rid)
+                    return
+                tried.append(b)
+                obs_flight.phase(rid, "dispatch", backend=b.addr)
+                obs_metrics.ROUTER_DISPATCH.inc(b.addr)
+                state.registry.acquire(b)
+                try:
+                    verdict = self._attempt(b, path, raw, chat, stream,
+                                            rid, ctx)
+                finally:
+                    state.registry.release(b)
+                if verdict == "done":
+                    obs_flight.retire(rid, reason="done", backend=b.addr)
+                    return
+                if verdict == "busy":
+                    continue  # not a failure; just try the next replica
+                if verdict == "lost":
+                    self._finish_replica_lost(ctx, chat, rid)
+                    return
+                # verdict == "retry": nothing client-visible happened —
+                # the request is still idempotent
+                if retries_left <= 0:
+                    self._out_of_backends(ctx, chat, rid)
+                    return
+                retries_left -= 1
+                obs_metrics.ROUTER_RETRIES.inc()
+                obs_flight.phase(rid, "retry", backend=b.addr)
+
+        def _out_of_backends(self, ctx: _Ctx, chat: bool,
+                             rid: str) -> None:
+            """No replica can take (or finish) this request."""
+            if ctx.headers_sent:
+                self._finish_replica_lost(ctx, chat, rid)
+                return
+            if ctx.busy is not None:
+                status, data, retry_after = ctx.busy
+                self._relay(status, data, "application/json",
+                            headers=[("Retry-After", retry_after)])
+                obs_flight.retire(rid, reason=f"busy_{status}")
+                return
+            self._json(503, {"error": "no backend available"},
+                       headers=[("Retry-After", "5")])
+            obs_flight.retire(rid, reason="no_backend")
+
+        def _finish_replica_lost(self, ctx: _Ctx, chat: bool,
+                                 rid: str) -> None:
+            """End a stream that already carried content: flag the
+            truncation instead of silently closing the socket."""
+            obs_metrics.ROUTER_REPLICA_LOST.inc()
+            if ctx.headers_sent and not ctx.client_gone:
+                self._client_chunk(ctx, chat, "", "replica_lost")
+                self._client_event(ctx, b"[DONE]")
+            elif not ctx.headers_sent:
+                # non-stream request whose backend vanished after the
+                # retry budget: a 502 is the honest answer
+                self._json(502, {"error": "backend lost mid-request",
+                                 "finish_reason": "replica_lost"})
+            obs_flight.retire(rid, reason="replica_lost")
+
+        def _attempt(self, b: Backend, path: str, raw: bytes, chat: bool,
+                     stream: bool, rid: str, ctx: _Ctx) -> str:
+            """One dispatch to one backend.  Returns a verdict:
+            ``done`` (response fully relayed), ``busy`` (replica said
+            429/503 — try a sibling), ``retry`` (backend failed with
+            nothing forwarded), ``lost`` (failed after content)."""
+            try:
+                conn = state.connect(b)
+            except OSError:
+                state.registry.record_failure(b)
+                return "retry"
+            try:
+                try:
+                    conn.request("POST", path, raw, headers={
+                        "Content-Type": "application/json",
+                        "X-Request-Id": rid,
+                        "X-Dllama-Hop": state.hop})
+                    resp = conn.getresponse()
+                except OSError:
+                    state.registry.record_failure(b)
+                    return "retry"
+                if resp.status in (429, 503):
+                    ctx.busy = (resp.status, resp.read(),
+                                resp.getheader("Retry-After") or "5")
+                    return "busy"
+                if resp.status != 200:
+                    # a client error is between the client and the model
+                    # server — relay it verbatim, no retry
+                    self._relay(resp.status, resp.read(),
+                                resp.getheader("Content-Type"))
+                    state.registry.record_success(b)
+                    obs_flight.phase(rid, "relay_error",
+                                     status=resp.status)
+                    return "done"
+                if "text/event-stream" in (resp.getheader("Content-Type")
+                                           or ""):
+                    return self._relay_stream(b, resp, chat, rid, ctx)
+                try:
+                    data = resp.read()
+                except OSError:
+                    state.registry.record_failure(b)
+                    return "retry"
+                state.registry.record_success(b)
+                return self._relay_json(b, data, chat, rid, ctx)
+            finally:
+                conn.close()
+
+        def _relay_stream(self, b: Backend, resp, chat: bool, rid: str,
+                          ctx: _Ctx) -> str:
+            self._sse_headers(ctx)
+            try:
+                for payload in _iter_sse(resp):
+                    if payload == b"[DONE]":
+                        state.registry.record_success(b)
+                        self._client_event(ctx, b"[DONE]")
+                        return "done"
+                    try:
+                        evt = json.loads(payload)
+                    except ValueError:
+                        continue
+                    if "error" in evt:
+                        # replica-side server error mid-stream: relay it
+                        # and the DONE that follows; no retry (the
+                        # replica is alive and already answered)
+                        self._client_event(ctx, payload)
+                        ctx.finished = True
+                        continue
+                    if ctx.cid is None:
+                        ctx.cid = evt.get("id")
+                        ctx.model = evt.get("model")
+                        ctx.created = evt.get("created")
+                    text, finish = _evt_fields(evt, chat)
+                    if finish == "handoff":
+                        # internal signal — never forwarded.  The held-
+                        # back text riding this chunk is NOT forwarded
+                        # either: the importer re-emits everything past
+                        # ctx.chars, so dropping it here keeps the
+                        # client's stream gapless and duplicate-free.
+                        return self._handoff(b, rid, chat, ctx,
+                                             stream=True)
+                    if not self._client_event(ctx, payload):
+                        return "done"  # client gone; nothing to salvage
+                    ctx.chars += len(text)
+                    if finish is not None:
+                        ctx.finished = True
+            except (OSError, http.client.HTTPException):
+                pass
+            # upstream socket died (or closed without [DONE])
+            state.registry.record_failure(b)
+            if ctx.finished:
+                # the finish chunk made it out; only [DONE] was lost
+                self._client_event(ctx, b"[DONE]")
+                return "done"
+            return "retry" if ctx.chars == 0 else "lost"
+
+        def _relay_json(self, b: Backend, data: bytes, chat: bool,
+                        rid: str, ctx: _Ctx) -> str:
+            try:
+                obj = json.loads(data)
+                choice = (obj.get("choices") or [{}])[0]
+                finish = choice.get("finish_reason")
+            except (ValueError, AttributeError):
+                self._relay(200, data, "application/json")
+                return "done"
+            if finish != "handoff":
+                self._relay(200, data, "application/json")
+                return "done"
+            # the replica drained mid-request: the buffered JSON holds a
+            # partial completion.  Resume on a peer and splice.
+            partial = (choice.get("message") or {}).get("content", "") \
+                if chat else choice.get("text", "")
+            cont = self._handoff_collect(b, rid, len(partial))
+            if cont is None:
+                if not partial:
+                    return "retry"  # nothing to lose: full re-run
+                obs_metrics.ROUTER_REPLICA_LOST.inc()
+                self._patch_json(obj, chat, partial, "replica_lost", None)
+                self._relay(200, json.dumps(obj).encode(),
+                            "application/json")
+                obs_flight.retire(rid, reason="replica_lost")
+                return "done"
+            tail, cont_finish, completion_tokens = cont
+            if chat and cont_finish == "length":
+                cont_finish = "stop"  # the chat budget contract
+            self._patch_json(obj, chat, partial + tail, cont_finish,
+                             completion_tokens)
+            self._relay(200, json.dumps(obj).encode(), "application/json")
+            return "done"
+
+        @staticmethod
+        def _patch_json(obj: dict, chat: bool, text: str,
+                        finish: str, completion_tokens: int | None) -> None:
+            choice = obj["choices"][0]
+            choice["finish_reason"] = finish
+            if chat:
+                choice.setdefault("message", {})["content"] = text
+            else:
+                choice["text"] = text
+            usage = obj.get("usage")
+            if usage and completion_tokens is not None:
+                usage["completion_tokens"] = completion_tokens
+                usage["total_tokens"] = \
+                    usage.get("prompt_tokens", 0) + completion_tokens
+
+        # -- KV hand-off -----------------------------------------------
+        def _fetch_record(self, b: Backend, rid: str) -> bytes | None:
+            try:
+                conn = state.connect(b)
+                try:
+                    conn.request("GET", f"/admin/export/{rid}")
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    return data if resp.status == 200 else None
+                finally:
+                    conn.close()
+            except OSError:
+                return None
+
+        def _offer_record(self, record: bytes, emitted_chars: int,
+                          exclude) -> tuple[Backend, object, object] | None:
+            """POST the record to peers best-first; returns the open
+            ``(peer, response, connection)`` of the accepting one."""
+            for peer in state.registry.handoff_peers(exclude=exclude):
+                try:
+                    conn = state.connect(peer)
+                    conn.request(
+                        "POST",
+                        f"/admin/import?emitted_chars={emitted_chars}",
+                        record,
+                        headers={"Content-Type":
+                                 "application/octet-stream"})
+                    resp = conn.getresponse()
+                except OSError:
+                    state.registry.record_failure(peer)
+                    continue
+                if resp.status == 200:
+                    return peer, resp, conn
+                body = resp.read()
+                conn.close()
+                if resp.status == 409:
+                    _log.info("peer %s refused hand-off (geometry): %s",
+                              peer.addr, body[:200])
+                    continue  # incompatible shape — a sibling may fit
+                if resp.status in (429, 503):
+                    continue  # saturated/draining — try the next peer
+                # 400 = the record itself is bad; no peer will differ
+                _log.warning("hand-off import rejected (%d): %s",
+                             resp.status, body[:200])
+                return None
+            return None
+
+        def _handoff(self, b: Backend, rid: str, chat: bool, ctx: _Ctx,
+                     *, stream: bool) -> str:
+            """Migrate an exported request to a peer and splice its
+            continuation into the client's open stream."""
+            obs_flight.phase(rid, "handoff", backend=b.addr,
+                             emitted_chars=ctx.chars)
+            record = self._fetch_record(b, rid)
+            got = self._offer_record(record, ctx.chars, exclude={b}) \
+                if record else None
+            if got is None:
+                # no record (request was still queued — nothing decoded)
+                # or no peer could take it: retry from scratch if the
+                # client saw nothing, else flag the truncation
+                return "retry" if ctx.chars == 0 else "lost"
+            peer, resp, conn = got
+            obs_metrics.ROUTER_HANDOFFS.inc()
+            obs_flight.phase(rid, "handoff_resume", backend=peer.addr)
+            try:
+                return self._relay_continuation(peer, resp, chat, rid,
+                                                ctx)
+            finally:
+                conn.close()
+
+        def _relay_continuation(self, peer: Backend, resp, chat: bool,
+                                rid: str, ctx: _Ctx) -> str:
+            """Forward a ``/admin/import`` continuation (always
+            text_completion-shaped) re-wrapped in the client's endpoint
+            shape, with the original stream's id/model/created."""
+            try:
+                for payload in _iter_sse(resp):
+                    if payload == b"[DONE]":
+                        state.registry.record_success(peer)
+                        if not ctx.finished:
+                            # error event upstream ended without finish
+                            return "lost"
+                        self._client_event(ctx, b"[DONE]")
+                        return "done"
+                    try:
+                        evt = json.loads(payload)
+                    except ValueError:
+                        continue
+                    if evt.get("object") == "handoff.usage" \
+                            or "error" in evt:
+                        continue
+                    choice = (evt.get("choices") or [{}])[0]
+                    text = choice.get("text") or ""
+                    finish = choice.get("finish_reason")
+                    if finish == "handoff":
+                        # the peer started draining too — chase the
+                        # record to the next replica (chained hand-off)
+                        if text:
+                            self._client_chunk(ctx, chat, text, None)
+                        return self._handoff(peer, rid, chat, ctx,
+                                             stream=True)
+                    if chat and finish == "length":
+                        finish = "stop"
+                    self._client_chunk(ctx, chat, text, finish)
+                    if ctx.client_gone:
+                        return "done"
+            except (OSError, http.client.HTTPException):
+                pass
+            state.registry.record_failure(peer)
+            if ctx.finished:
+                self._client_event(ctx, b"[DONE]")
+                return "done"
+            return "lost"  # the record was consumed; no second chance
+
+        def _handoff_collect(self, b: Backend, rid: str,
+                             emitted_chars: int
+                             ) -> tuple[str, str, int | None] | None:
+            """Non-streaming twin of :meth:`_handoff`: fetch + offer,
+            then drain the continuation into ``(tail_text, finish,
+            completion_tokens)``.  Follows chained hand-offs."""
+            obs_flight.phase(rid, "handoff", backend=b.addr,
+                             emitted_chars=emitted_chars)
+            record = self._fetch_record(b, rid)
+            got = self._offer_record(record, emitted_chars,
+                                     exclude={b}) if record else None
+            if got is None:
+                return None
+            peer, resp, conn = got
+            obs_metrics.ROUTER_HANDOFFS.inc()
+            obs_flight.phase(rid, "handoff_resume", backend=peer.addr)
+            parts: list[str] = []
+            finish = None
+            completion_tokens = None
+            try:
+                for payload in _iter_sse(resp):
+                    if payload == b"[DONE]":
+                        break
+                    try:
+                        evt = json.loads(payload)
+                    except ValueError:
+                        continue
+                    if evt.get("object") == "handoff.usage":
+                        completion_tokens = (evt.get("usage") or {}) \
+                            .get("completion_tokens")
+                        continue
+                    if "error" in evt:
+                        return None
+                    choice = (evt.get("choices") or [{}])[0]
+                    parts.append(choice.get("text") or "")
+                    finish = choice.get("finish_reason") or finish
+            except (OSError, http.client.HTTPException):
+                state.registry.record_failure(peer)
+                return None
+            finally:
+                conn.close()
+            if finish == "handoff":
+                nxt = self._handoff_collect(
+                    peer, rid, emitted_chars + sum(map(len, parts)))
+                if nxt is None:
+                    return None
+                tail2, finish2, ct2 = nxt
+                return "".join(parts) + tail2, finish2, ct2
+            if finish is None:
+                return None
+            state.registry.record_success(peer)
+            return "".join(parts), finish, completion_tokens
+
+    return Handler
+
+
+def serve(state: RouterState, *, host: str = "0.0.0.0",
+          port: int = 9990) -> None:
+    httpd = ThreadingHTTPServer((host, port), make_handler(state))
+    httpd.daemon_threads = True
+    state.registry.start()
+
+    def _shutdown(signum, frame):
+        _log.info("router signal %d: shutting down", signum)
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
+    _log.info("router listening on %s:%d fronting %s", host, port,
+              ",".join(b.addr for b in state.registry.backends))
+    print(f"💡 router on {host}:{port} → "
+          f"{len(state.registry.backends)} backends", flush=True)
+    try:
+        httpd.serve_forever()
+    finally:
+        state.registry.stop()
+        httpd.server_close()
+
+
+def main(args) -> None:
+    addrs = [a.strip() for a in (getattr(args, "backends", None) or "")
+             .split(",") if a.strip()]
+    if not addrs:
+        raise SystemExit("router mode requires --backends host:port,...")
+    registry = Registry(
+        addrs,
+        probe_interval=getattr(args, "probe_interval", 2.0),
+        eject_after=getattr(args, "eject_after", 3),
+        readmit_after=getattr(args, "readmit_after", 2),
+        probe_timeout=min(float(getattr(args, "upstream_timeout", 120.0)),
+                          5.0))
+    state = RouterState(
+        registry,
+        retries=getattr(args, "router_retries", 2),
+        upstream_timeout=getattr(args, "upstream_timeout", 120.0))
+    serve(state, host=getattr(args, "host", "0.0.0.0"),
+          port=getattr(args, "port", 9990))

@@ -504,6 +504,62 @@ class Engine:
         bump_counter("snapshot_restores")
         return dict(meta.get("extra", {}))
 
+    # -- per-request KV hand-off (DLREQ01, runtime/snapshot.py) ---------
+    def handoff_fingerprint(self) -> str:
+        """Geometry digest for per-request KV hand-off.
+
+        Looser than :meth:`config_fingerprint`: a request's pages mean
+        the same thing on any replica with the same model, context
+        window, and page shape/dtype — batch width and pool *size* are
+        deliberately excluded (the importer allocates its own physical
+        pages), so a 4-slot and an 8-slot replica can exchange requests
+        as long as their page geometry matches."""
+        from . import snapshot as snapfmt
+        if not self.paged:
+            raise ValueError("per-request hand-off needs a paged KV cache "
+                             "(kv_pages > 0)")
+        c = self.cfg
+        k = self.cache.k
+        fields = {
+            "arch": c.arch, "dim": c.dim, "hidden_dim": c.hidden_dim,
+            "n_layers": c.n_layers, "n_heads": c.n_heads,
+            "n_kv_heads": c.n_kv_heads, "n_experts": c.n_experts,
+            "n_active_experts": c.n_active_experts,
+            "vocab_size": c.vocab_size, "hidden_act": c.hidden_act,
+            "rope_theta": c.rope_theta, "seq_len": self.seq_len,
+            # page shape (Hkv, ps, Dh) + dtype, not pool page count
+            "page": [str(k.dtype), list(k.shape[2:])],
+            "handoff": 1,
+        }
+        return snapfmt.fingerprint(fields)
+
+    def set_rng(self, key_np, chunk_counter: int) -> None:
+        """Rebase the sampler RNG stream (hand-off import: continue the
+        exporting replica's draw sequence instead of this process's)."""
+        self._key = jnp.asarray(key_np)
+        self._chunk_counter = int(chunk_counter)
+
+    def read_pool_pages(self, pages) -> dict[str, np.ndarray]:
+        """Copy the given physical pages out of the paged pool to host
+        numpy, all layers at once: shape ``(L, n, Hkv, ps, Dh)``.  Used
+        by the scheduler's drain-time export."""
+        idx = np.asarray(pages, np.int32)
+        return {"pages.k": np.asarray(self.cache.k)[:, idx],
+                "pages.v": np.asarray(self.cache.v)[:, idx]}
+
+    def write_pool_pages(self, pages, arrays: dict[str, np.ndarray]) -> None:
+        """Write exported page slices (from :meth:`read_pool_pages` on a
+        peer) into this engine's pool at the given physical page ids.
+        One transient pool copy — acceptable at hand-off import time,
+        which is off the steady-state decode path."""
+        from ..models.transformer import KVCache
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+        new_k = self.cache.k.at[:, idx].set(
+            jnp.asarray(arrays["pages.k"], self.cache.k.dtype))
+        new_v = self.cache.v.at[:, idx].set(
+            jnp.asarray(arrays["pages.v"], self.cache.v.dtype))
+        self.cache = jax.device_put(KVCache(new_k, new_v), self._cache_sh)
+
     def _sync(self, arrays, what: str) -> list[str]:
         """Block until ``arrays`` are device-ready — THE engine's blocking
         edge — under the watchdog, firing the ``engine.device_step`` fault

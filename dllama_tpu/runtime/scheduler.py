@@ -85,10 +85,16 @@ class Ticket:
         self.top_p = float(top_p)
         self.eos_ids = tuple(eos_ids)
         self.deadline = deadline  # time.monotonic() or None
-        self.finish: str | None = None  # stop/length/timeout/aborted/error
+        self.finish: str | None = None  # stop/length/timeout/aborted/error/handoff
         self.error: BaseException | None = None
         self.slot: int | None = None
         self.submitted_at = time.monotonic()
+        # hand-off state (runtime/snapshot.py DLREQ01): the server parks
+        # its stop strings here so a drain-time export can ship them, and
+        # every emitted completion token is kept so the importing replica
+        # can rebuild the full decode/stop-scan state
+        self.stop: list[str] = []
+        self.emitted: list[int] = []
         # the submitting thread's X-Request-Id rides the ticket onto the
         # scheduler thread, where the contextvar is not set — spans, logs
         # and the flight record all stamp this one grep-able ID
@@ -171,6 +177,12 @@ class SlotScheduler:
             obs_metrics.KV_PAGES_IN_USE.set(0)
         self._queue: deque[Ticket] = deque()
         self._cond = threading.Condition()
+        # serializes engine cache access between the dispatch loop (whose
+        # jit step donates the cache buffer) and the hand-off export/
+        # import paths, which read/write pool pages from other threads.
+        # Scoped strictly around the device calls — never held while
+        # taking self._cond, so the two locks cannot deadlock.
+        self._engine_lock = threading.Lock()
         self._draining = False
         self._stop = False
         self._idle = threading.Event()  # set while paused with empty slots
@@ -334,6 +346,206 @@ class SlotScheduler:
                 self.prefix_cache.restore(extra.get("radix") or [])
             obs_metrics.KV_PAGES_IN_USE.set(self.pool.in_use)
             return extra
+
+    # -- per-request KV hand-off (runtime/snapshot.py DLREQ01) ----------
+    def _export_slot_locked(self, slot_idx: int) -> bytes:
+        """Serialize one live slot to a DLREQ01 record (caller holds
+        ``self._cond``).  The record carries the slot's written KV pages
+        (positions ``[0, pos)``), the full prompt + completion token ids,
+        sampling params, remaining deadline, and the engine's sampler RNG
+        stream — everything a geometry-compatible peer needs to resume
+        decode without re-prefilling."""
+        import math
+
+        s = self.slots[slot_idx]
+        t = s.ticket
+        ps = self.pool.page_size
+        n_data = math.ceil(s.pos / ps)
+        deadline_left = None
+        if t.deadline is not None:
+            deadline_left = max(t.deadline - time.monotonic(), 0.0)
+        # pages may contain stale values above pos (an in-flight dispatch
+        # whose fanout never ran) — harmless, the importer's causal
+        # ceiling masks them exactly like slot reuse does
+        with self._engine_lock:
+            arrays = self.engine.read_pool_pages(s.pages[:n_data])
+            arrays["rng_key"] = np.asarray(self.engine._key)
+            chunk_counter = self.engine._chunk_counter
+        from . import snapshot as snapfmt
+        return snapfmt.dumps_request(
+            fingerprint=self.engine.handoff_fingerprint(),
+            pos=s.pos, chunk_counter=chunk_counter, arrays=arrays,
+            extra={
+                "rid": t.rid, "prompt": list(t.prompt),
+                "completion": list(t.emitted), "max_new": t.max_new,
+                "temperature": t.temperature, "top_p": t.top_p,
+                "eos_ids": list(t.eos_ids), "stop": list(t.stop),
+                "deadline_left": deadline_left,
+                "fed": s.fed, "produced": s.produced, "last": s.last,
+            })
+
+    def handoff_export_all(self) -> dict[str, bytes]:
+        """Drain-time hand-off: export every live slot to a DLREQ01
+        record keyed by request id and retire it with finish
+        ``handoff``; queued (never-admitted) tickets retire ``handoff``
+        with no record — the router re-submits those from scratch, which
+        is idempotent because nothing was ever streamed."""
+        if self.pool is None:
+            return {}
+        records: dict[str, bytes] = {}
+        with self._cond:
+            for i in self._active():
+                t = self.slots[i].ticket
+                try:
+                    records[t.rid] = self._export_slot_locked(i)
+                except Exception as e:
+                    # an unexportable slot degrades to a plain drain
+                    # abort for that request; the fleet must not lose
+                    # the other slots over it
+                    _log.error("handoff export failed", extra={
+                        "rid": t.rid, "error": repr(e)})
+                self._retire(i, "handoff")
+            while self._queue:
+                self._fail_ticket(self._queue.popleft(), "handoff")
+            self._cond.notify_all()
+        if records:
+            _log.info("handoff export", extra={"requests": len(records)})
+        return records
+
+    def import_request(self, blob: bytes) -> tuple[Ticket, dict]:
+        """Re-bind an exported request (DLREQ01 bytes) into a free slot:
+        allocate this pool's own physical pages, write the exported page
+        slices into them, and resume the slot's clocks exactly where the
+        exporter stopped — continued greedy decode is byte-identical to
+        never having moved (tests/test_handoff.py pins this).
+
+        Raises :class:`~dllama_tpu.io.integrity.ArtifactError` on a
+        corrupt record, :class:`SnapshotMismatch` on incompatible
+        geometry, :class:`SchedulerSaturated` when no slot/pages are
+        free, :class:`SchedulerClosed` when this replica is itself
+        draining.  Returns ``(ticket, record_extra)``.
+
+        The exporter's sampler RNG stream is restored only when this
+        scheduler has no other live work — the engine RNG is shared
+        across slots, so rebasing it under co-scheduled requests would
+        perturb their draws.  Greedy (temperature-0) requests do not
+        consume the stream and hand off byte-identically regardless.
+        """
+        from . import snapshot as snapfmt
+
+        if self.pool is None:
+            raise ValueError("hand-off import needs a paged scheduler "
+                             "(--kv-pages)")
+        meta, arrays = snapfmt.loads_request(blob)
+        eng = self.engine
+        want = eng.handoff_fingerprint()
+        if meta["fingerprint"] != want:
+            raise snapfmt.SnapshotMismatch(
+                "<handoff record>", "fingerprint",
+                "record is from a replica with incompatible geometry",
+                expected=want, got=meta["fingerprint"])
+        extra = dict(meta.get("extra", {}))
+        prompt = [int(x) for x in extra.get("prompt") or []]
+        completion = [int(x) for x in extra.get("completion") or []]
+        pos = int(meta["pos"])
+        max_new = int(extra.get("max_new", 1))
+        fed = int(extra.get("fed", 0))
+        produced = int(extra.get("produced", len(completion)))
+        if not prompt or max_new < 1 or not (0 <= pos <= eng.seq_len) \
+                or not (0 <= fed <= len(prompt)) or produced < 0:
+            raise snapfmt.SnapshotMismatch(
+                "<handoff record>", "extra",
+                "inconsistent request state in hand-off record")
+        ps = self.pool.page_size
+        n_data = -(-pos // ps)
+        pk, pv = arrays.get("pages.k"), arrays.get("pages.v")
+        kvshape = eng.cache.k.shape
+        want_shape = (kvshape[0], n_data) + tuple(kvshape[2:])
+        for name, arr in (("pages.k", pk), ("pages.v", pv)):
+            if arr is None or tuple(arr.shape) != want_shape:
+                raise snapfmt.SnapshotMismatch(
+                    "<handoff record>", f"array {name!r}",
+                    "page payload does not match the record position",
+                    expected=str(want_shape),
+                    got="missing" if arr is None else str(arr.shape))
+        need = min(len(prompt) + max_new, eng.seq_len)
+        n_total = -(-need // ps)
+        if n_total > self.pool.capacity:
+            from .engine import ContextOverflow
+            raise ContextOverflow(
+                f"request needs {n_total} KV pages but the pool has "
+                f"{self.pool.capacity}")
+        deadline = None
+        if extra.get("deadline_left") is not None:
+            deadline = time.monotonic() + float(extra["deadline_left"])
+        with self._cond:
+            if self._stop or self._draining:
+                raise SchedulerClosed("scheduler is draining")
+            slot_idx = next((i for i, s in enumerate(self.slots)
+                             if s.ticket is None), None)
+            if slot_idx is None:
+                raise SchedulerSaturated("no free slot for hand-off import")
+            try:
+                pages = self.pool.alloc(n_total)
+            except PagePoolExhausted:
+                pages = None
+                if self.prefix_cache is not None:
+                    self.prefix_cache.evict(n_total - self.pool.available)
+                    try:
+                        pages = self.pool.alloc(n_total)
+                    except PagePoolExhausted:
+                        pass
+            if pages is None:
+                raise SchedulerSaturated(
+                    "no free KV pages for hand-off import")
+            others = any(s.ticket is not None for s in self.slots)
+            with self._engine_lock:
+                if n_data:
+                    eng.write_pool_pages(pages[:n_data],
+                                         {"pages.k": pk, "pages.v": pv})
+                if not others and not self._queue and "rng_key" in arrays:
+                    eng.set_rng(arrays["rng_key"],
+                                int(meta["chunk_counter"]))
+            t = Ticket(prompt, max_new,
+                       float(extra.get("temperature", 0.0)),
+                       float(extra.get("top_p", 0.9)),
+                       tuple(int(e) for e in extra.get("eos_ids") or ()),
+                       deadline)
+            t.rid = str(extra.get("rid") or t.rid)
+            t.stop = [str(x) for x in extra.get("stop") or []]
+            t.emitted = list(completion)
+            t._on_cancel = self._wake
+            s = self.slots[slot_idx]
+            s.ticket = t
+            s.pages = pages
+            s.prefix_tokens = 0
+            # prompt pages become radix-shareable once prefill completes;
+            # a decode-phase import never re-inserts (alignment with the
+            # exporter's shared prefixes is unknowable here)
+            s.inserted = fed >= len(prompt)
+            s.pos = pos
+            s.fed = fed
+            s.produced = produced
+            s.last = int(extra.get("last", 0))
+            t.slot = slot_idx
+            row = self._page_tables[slot_idx]
+            row[:] = 0
+            row[:len(pages)] = pages
+            obs_metrics.KV_PAGES_IN_USE.set(self.pool.in_use)
+            obs_metrics.SCHED_SLOT_JOINS.inc(slot_idx)
+            self._cond.notify_all()
+        obs_flight.submit(t.rid, n_prompt=len(prompt), max_new=max_new,
+                          temperature=t.temperature, source="handoff")
+        obs_flight.admit(t.rid, slot=slot_idx, queued_ms=0.0,
+                         prefix_reused=0)
+        ctx = request_id_var.set(t.rid)
+        try:
+            _log.info("handoff import", extra={
+                "slot": slot_idx, "pos": pos, "produced": produced,
+                "pages": len(pages)})
+        finally:
+            request_id_var.reset(ctx)
+        return t, extra
 
     # -- scheduler thread ----------------------------------------------
     def _retire(self, slot_idx: int, reason: str,
@@ -641,10 +853,12 @@ class SlotScheduler:
         t0 = time.monotonic()
         error = None
         try:
-            out = eng.slot_step(tokens, pos_rows, n_valid,
-                                temps_np=temps, topps_np=topps, steps=steps,
-                                page_tables_np=self._page_tables
-                                if self.paged else None)
+            with self._engine_lock:
+                out = eng.slot_step(tokens, pos_rows, n_valid,
+                                    temps_np=temps, topps_np=topps,
+                                    steps=steps,
+                                    page_tables_np=self._page_tables
+                                    if self.paged else None)
         except Exception as e:
             error = e
         tp1 = time.perf_counter()
@@ -683,6 +897,42 @@ class SlotScheduler:
                          rids=sorted(rid_by_slot.values()))
 
         emitted = dict.fromkeys(active, 0)
+        # the whole fanout holds _cond (re-entrant with the _retire calls
+        # below): slot clocks (pos/fed/produced/last) and the ticket's
+        # emitted list must never be observable half-advanced by the
+        # hand-off exporter, which snapshots them from another thread
+        with self._cond:
+            self._fanout(active, steps, out, n_valid, emitted)
+
+        # flight phases + timeline entry for this dispatch (after the
+        # fanout so the emitted-token counts are final; a row retired
+        # mid-burst still gets its last burst recorded)
+        for i in active:
+            rid = rid_by_slot[i]
+            if i in prefset:
+                # a completing chunk also emits the first sampled token —
+                # recorded as ``emitted`` on the chunk, not a zero-wall
+                # synthetic burst
+                obs_flight.phase(rid, "prefill_chunk",
+                                 tokens=fed_by_slot[i], ms=wall_ms,
+                                 pos=int(pos_rows[i]), emitted=emitted[i])
+            else:
+                obs_flight.phase(rid, "decode_burst", steps=steps,
+                                 tokens=emitted[i], wall_ms=wall_ms,
+                                 step_ms=step_ms)
+        obs_flight.TIMELINE.record_step(
+            ts=tp0, wall_ms=wall_ms,
+            device_ms=getattr(eng, "last_slot_dispatch_ms", None),
+            host_gap_ms=host_gap_ms, idle_ms=idle_ms, steps=steps,
+            t_width=t_width,
+            slots=self._slot_entries(active, prefset, rid_by_slot, emitted))
+
+    def _fanout(self, active: list[int], steps: int, out, n_valid,
+                emitted: dict[int, int]) -> None:
+        """Distribute one dispatch's sampled tokens to their tickets and
+        advance the slot clocks.  Caller holds ``self._cond``."""
+        eng = self.engine
+        slots = self.slots
         for j in range(steps):
             for i in active:
                 s = slots[i]
@@ -716,30 +966,8 @@ class SlotScheduler:
                     continue
                 s.produced += 1
                 emitted[i] += 1
+                t.emitted.append(tok)
                 t._q.put(tok)
                 if s.produced >= t.max_new or s.pos >= eng.seq_len:
                     with self._cond:
                         self._retire(i, "length")
-
-        # flight phases + timeline entry for this dispatch (after the
-        # fanout so the emitted-token counts are final; a row retired
-        # mid-burst still gets its last burst recorded)
-        for i in active:
-            rid = rid_by_slot[i]
-            if i in prefset:
-                # a completing chunk also emits the first sampled token —
-                # recorded as ``emitted`` on the chunk, not a zero-wall
-                # synthetic burst
-                obs_flight.phase(rid, "prefill_chunk",
-                                 tokens=fed_by_slot[i], ms=wall_ms,
-                                 pos=int(pos_rows[i]), emitted=emitted[i])
-            else:
-                obs_flight.phase(rid, "decode_burst", steps=steps,
-                                 tokens=emitted[i], wall_ms=wall_ms,
-                                 step_ms=step_ms)
-        obs_flight.TIMELINE.record_step(
-            ts=tp0, wall_ms=wall_ms,
-            device_ms=getattr(eng, "last_slot_dispatch_ms", None),
-            host_gap_ms=host_gap_ms, idle_ms=idle_ms, steps=steps,
-            t_width=t_width,
-            slots=self._slot_entries(active, prefset, rid_by_slot, emitted))

@@ -47,6 +47,11 @@ from ..obs.log import get_logger
 _log = get_logger("runtime.snapshot")
 
 MAGIC = b"DLSNAP02"
+# Per-request hand-off record: one in-flight request's KV pages +
+# decode state, shipped over HTTP between replicas (never a file on
+# disk).  Same header/crc/descriptor machinery as DLSNAP02, distinct
+# magic so neither format can be fed to the other's loader.
+REQ_MAGIC = b"DLREQ01\0"
 # DLSNAP01 lacked the paged-KV state (page pool geometry in the
 # fingerprint, page tables + radix-tree keys in the extras); restoring
 # one silently would resurrect a contiguous cache under a paged engine.
@@ -74,12 +79,11 @@ def _np_dtype(name: str) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
-def save(path: str | os.PathLike, *, fingerprint: str, pos: int,
-         chunk_counter: int, arrays: dict[str, np.ndarray],
-         extra: dict | None = None) -> str:
-    """Write a snapshot atomically (tmp + rename): a crash mid-write
-    leaves the previous snapshot (or none), never a torn file."""
-    path = os.fspath(path)
+def _encode(magic: bytes, *, fingerprint: str, pos: int, chunk_counter: int,
+            arrays: dict[str, np.ndarray],
+            extra: dict | None) -> tuple[bytes, bytes, list[bytes]]:
+    """Serialize to ``(header, meta, blobs)`` — shared by the DLSNAP02
+    file writer and the DLREQ01 in-memory encoder."""
     descs, blobs = [], []
     for name, arr in arrays.items():
         arr = np.ascontiguousarray(arr)
@@ -95,9 +99,80 @@ def save(path: str | os.PathLike, *, fingerprint: str, pos: int,
     crc = zlib.crc32(meta)
     for blob in blobs:
         crc = zlib.crc32(blob, crc)
+    return _HEADER.pack(magic, len(meta), crc & 0xFFFFFFFF), meta, blobs
+
+
+def _decode_body(label: str, body: bytes, meta_len: int,
+                 crc_want: int) -> tuple[dict, dict[str, np.ndarray]]:
+    """Validate and parse ``meta || payload`` (everything after the
+    header).  Shared by :func:`load` and :func:`loads_request`."""
+    if len(body) < meta_len:
+        raise ArtifactError(label, "meta", "file truncated mid-field",
+                            offset=_HEADER.size,
+                            expected=f"{meta_len} bytes",
+                            got=f"{len(body)} bytes")
+    crc_got = zlib.crc32(body) & 0xFFFFFFFF
+    if crc_got != crc_want:
+        raise ArtifactError(label, "checksum",
+                            "checksum mismatch — snapshot bytes are corrupt",
+                            offset=_HEADER.size,
+                            expected=f"crc32={crc_want:#010x}",
+                            got=f"crc32={crc_got:#010x}")
+    try:
+        meta = json.loads(body[:meta_len].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ArtifactError(label, "meta", f"unreadable snapshot meta: {e}",
+                            offset=_HEADER.size) from e
+    for key in ("fingerprint", "pos", "chunk_counter", "arrays"):
+        if key not in meta:
+            raise ArtifactError(label, f"meta.{key}",
+                                "missing required snapshot key")
+    payload = body[meta_len:]
+    arrays: dict[str, np.ndarray] = {}
+    off = 0
+    for d in meta["arrays"]:
+        try:
+            name, nbytes = d["name"], int(d["nbytes"])
+            dt = _np_dtype(d["dtype"])
+            shape = tuple(int(s) for s in d["shape"])
+        except (KeyError, TypeError, ValueError, AttributeError) as e:
+            raise ArtifactError(label, "meta.arrays",
+                                f"bad array descriptor {d!r}: {e}") from e
+        want = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+        if nbytes != want:
+            raise ArtifactError(label, f"array {name!r}",
+                                "descriptor nbytes disagrees with dtype×shape",
+                                expected=want, got=nbytes)
+        if off + nbytes > len(payload):
+            raise ArtifactError(label, f"array {name!r}",
+                                "payload truncated",
+                                offset=_HEADER.size + meta_len + off,
+                                expected=f"{nbytes} bytes",
+                                got=f"{len(payload) - off} bytes")
+        arrays[name] = np.frombuffer(
+            payload, dtype=dt, count=int(np.prod(shape, dtype=np.int64)),
+            offset=off).reshape(shape)
+        off += nbytes
+    if off != len(payload):
+        raise ArtifactError(label, "payload",
+                            "trailing bytes after last array",
+                            offset=_HEADER.size + meta_len + off,
+                            expected="EOF", got=f"{len(payload) - off} extra bytes")
+    return meta, arrays
+
+
+def save(path: str | os.PathLike, *, fingerprint: str, pos: int,
+         chunk_counter: int, arrays: dict[str, np.ndarray],
+         extra: dict | None = None) -> str:
+    """Write a snapshot atomically (tmp + rename): a crash mid-write
+    leaves the previous snapshot (or none), never a torn file."""
+    path = os.fspath(path)
+    header, meta, blobs = _encode(MAGIC, fingerprint=fingerprint, pos=pos,
+                                  chunk_counter=chunk_counter, arrays=arrays,
+                                  extra=extra)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        f.write(_HEADER.pack(MAGIC, len(meta), crc & 0xFFFFFFFF))
+        f.write(header)
         f.write(meta)
         for blob in blobs:
             f.write(blob)
@@ -144,61 +219,52 @@ def load(path: str | os.PathLike) -> tuple[dict, dict[str, np.ndarray]]:
                                 offset=8, expected=f"2..{_MAX_META}",
                                 got=meta_len)
         body = f.read()
-    if len(body) < meta_len:
-        raise ArtifactError(path, "meta", "file truncated mid-field",
-                            offset=_HEADER.size,
-                            expected=f"{meta_len} bytes",
-                            got=f"{len(body)} bytes")
-    crc_got = zlib.crc32(body) & 0xFFFFFFFF
-    if crc_got != crc_want:
-        raise ArtifactError(path, "checksum",
-                            "checksum mismatch — snapshot bytes are corrupt",
-                            offset=_HEADER.size,
-                            expected=f"crc32={crc_want:#010x}",
-                            got=f"crc32={crc_got:#010x}")
-    try:
-        meta = json.loads(body[:meta_len].decode("utf-8"))
-    except (UnicodeDecodeError, json.JSONDecodeError) as e:
-        raise ArtifactError(path, "meta", f"unreadable snapshot meta: {e}",
-                            offset=_HEADER.size) from e
-    for key in ("fingerprint", "pos", "chunk_counter", "arrays"):
-        if key not in meta:
-            raise ArtifactError(path, f"meta.{key}",
-                                "missing required snapshot key")
-    payload = body[meta_len:]
-    arrays: dict[str, np.ndarray] = {}
-    off = 0
-    for d in meta["arrays"]:
-        try:
-            name, nbytes = d["name"], int(d["nbytes"])
-            dt = _np_dtype(d["dtype"])
-            shape = tuple(int(s) for s in d["shape"])
-        except (KeyError, TypeError, ValueError, AttributeError) as e:
-            raise ArtifactError(path, "meta.arrays",
-                                f"bad array descriptor {d!r}: {e}") from e
-        want = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
-        if nbytes != want:
-            raise ArtifactError(path, f"array {name!r}",
-                                "descriptor nbytes disagrees with dtype×shape",
-                                expected=want, got=nbytes)
-        if off + nbytes > len(payload):
-            raise ArtifactError(path, f"array {name!r}",
-                                "payload truncated",
-                                offset=_HEADER.size + meta_len + off,
-                                expected=f"{nbytes} bytes",
-                                got=f"{len(payload) - off} bytes")
-        arrays[name] = np.frombuffer(
-            payload, dtype=dt, count=int(np.prod(shape, dtype=np.int64)),
-            offset=off).reshape(shape)
-        off += nbytes
-    if off != len(payload):
-        raise ArtifactError(path, "payload",
-                            "trailing bytes after last array",
-                            offset=_HEADER.size + meta_len + off,
-                            expected="EOF", got=f"{len(payload) - off} extra bytes")
+    meta, arrays = _decode_body(path, body, meta_len, crc_want)
     _log.debug("snapshot_loaded", extra={
         "path": path, "bytes": file_size, "pos": int(meta["pos"])})
     return meta, arrays
+
+
+def dumps_request(*, fingerprint: str, pos: int, chunk_counter: int,
+                  arrays: dict[str, np.ndarray], extra: dict) -> bytes:
+    """Serialize a per-request hand-off record (DLREQ01) to bytes.
+
+    Same layout as a DLSNAP02 file but with :data:`REQ_MAGIC` and never
+    written to disk — records travel as an HTTP octet-stream between a
+    draining replica and the peer that resumes the request.  ``extra``
+    carries the request's decode state (prompt/completion tokens,
+    sampling params, slot counters); ``arrays`` carries the KV page
+    slices and sampler RNG key.
+    """
+    header, meta, blobs = _encode(REQ_MAGIC, fingerprint=fingerprint,
+                                  pos=pos, chunk_counter=chunk_counter,
+                                  arrays=arrays, extra=extra)
+    return b"".join([header, meta, *blobs])
+
+
+def loads_request(blob: bytes,
+                  label: str = "<handoff record>") -> tuple[dict, dict[str, np.ndarray]]:
+    """Parse and fully validate a DLREQ01 record from bytes.
+
+    Raises :class:`ArtifactError` on any corruption, exactly like
+    :func:`load`; geometry/fingerprint checking stays with the importing
+    scheduler, which knows its engine's shape.
+    """
+    if len(blob) < _HEADER.size:
+        raise ArtifactError(label, "snapshot header",
+                            "file truncated mid-field", offset=0,
+                            expected=f"{_HEADER.size} bytes",
+                            got=f"{len(blob)} bytes")
+    magic, meta_len, crc_want = _HEADER.unpack(blob[:_HEADER.size])
+    if magic != REQ_MAGIC:
+        raise ArtifactError(label, "magic", "not a dllama hand-off record",
+                            offset=0, expected=REQ_MAGIC, got=magic)
+    if not (2 <= meta_len <= min(_MAX_META, len(blob))):
+        raise ArtifactError(label, "meta_len",
+                            "value out of range — corrupt record",
+                            offset=8, expected=f"2..{_MAX_META}",
+                            got=meta_len)
+    return _decode_body(label, blob[_HEADER.size:], meta_len, crc_want)
 
 
 def fingerprint(fields: dict) -> str:

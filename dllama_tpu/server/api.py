@@ -79,6 +79,7 @@ from ..runtime.engine import ContextOverflow, Engine, NumericFault, StepTimeout
 from ..runtime.faults import FAULTS
 from ..runtime.scheduler import (SchedulerClosed, SchedulerSaturated,
                                  SlotScheduler)
+from ..runtime.snapshot import SnapshotMismatch
 from ..runtime.stream import drain_generation
 from ..tokenizer.bpe import Tokenizer
 from ..tokenizer.chat import ChatItem, ChatTemplate, TokenizerChatStops
@@ -94,6 +95,10 @@ _RID_MAX = 64
 #: request bodies above this are refused with 413 (an unbounded
 #: Content-Length read is an easy memory DoS against a model server)
 MAX_BODY_BYTES = 8 * 1024 * 1024
+
+#: /admin/import bodies (DLREQ01 hand-off records) carry raw KV pages,
+#: which dwarf JSON bodies — separate, much larger bound
+MAX_HANDOFF_BYTES = 1 << 30
 
 
 def _decode_continuation(tok: Tokenizer, prev: int, token_ids: list[int]) -> str:
@@ -330,7 +335,7 @@ class ApiState:
                  io_timeout: float = 15.0, drain_grace: float = 30.0,
                  snapshot_dir: str | None = None,
                  scheduler: SlotScheduler | None = None,
-                 slo=None):
+                 slo=None, handoff: bool = False):
         self.engine = engine
         self.snapshot_dir = snapshot_dir
         self.batch_engine = batch_engine
@@ -358,6 +363,13 @@ class ApiState:
         self._active = 0    # holding the engine mutex (0 or 1)
         self.draining = False
         self.drain_deadline: float | None = None
+        # ---- per-request KV hand-off (--handoff; fleet router) ----
+        # opt-in: with it on, a drain EXPORTS live slot requests as
+        # DLREQ01 records (finish "handoff") for the router to re-bind on
+        # a peer, instead of finishing them here within the grace window
+        self.handoff = bool(handoff and scheduler is not None
+                            and scheduler.pool is not None)
+        self.handoff_records: dict[str, bytes] = {}
 
     # -- admission / drain ---------------------------------------------
     def try_enter(self) -> str:
@@ -395,6 +407,20 @@ class ApiState:
             # slot-path requests drain too: no new submissions, every
             # in-flight and queued ticket's deadline clamps to the grace
             self.scheduler.begin_drain(self.drain_deadline)
+            if self.handoff:
+                # export every live slot as a DLREQ01 record the router
+                # fetches via GET /admin/export/<rid>; the requests'
+                # handlers see finish "handoff" and answer immediately,
+                # so the drain completes in O(export) rather than
+                # O(longest in-flight decode)
+                try:
+                    self.handoff_records.update(
+                        self.scheduler.handoff_export_all())
+                except Exception as e:
+                    # a failed export degrades to a plain grace-bounded
+                    # drain; it must never turn SIGTERM into a crash
+                    _log.error("handoff_export_failed",
+                               extra={"error": repr(e)})
 
     # -- engine-state snapshot (warm restart; runtime/snapshot.py) ------
     @property
@@ -543,6 +569,21 @@ class ApiState:
         except Exception:
             backend = "unknown"
         in_flight, queued = self.queue_depths()
+        occ = self.scheduler.occupancy() if self.scheduler is not None \
+            else None
+        # machine-readable capacity block (fleet satellite): everything
+        # the router's least-loaded scorer needs in one probe, without
+        # scraping Prometheus text.  Additive — the pre-fleet fields
+        # below keep their exact shapes.
+        capacity = {
+            "free_slots": (occ["slots"] - occ["active"]) if occ
+            else max(self.max_pending - in_flight - queued, 0),
+            "free_kv_pages": occ.get("kv_pages_free") if occ else None,
+            "queue_depth": queued + (occ["queued"] if occ else 0),
+            "batch_efficiency":
+                obs_metrics.SCHED_BATCH_EFFICIENCY.json_value(),
+            "handoff": self.handoff,
+        }
         return {
             "status": "draining" if self.draining else "ok",
             "ready": True,  # the model loads before serve() binds the port
@@ -553,8 +594,8 @@ class ApiState:
             "batch_slots": self.batch_engine.batch if self.batch_engine else 0,
             # slot-scheduler occupancy (satellite: /health must surface it
             # alongside batch_slots so an over-n client can size retries)
-            "scheduler": self.scheduler.occupancy()
-            if self.scheduler is not None else None,
+            "scheduler": occ,
+            "capacity": capacity,
             "in_flight": in_flight,
             "queued": queued,
             "max_pending": self.max_pending,
@@ -1088,11 +1129,13 @@ class ApiState:
     # -- continuous batching (runtime/scheduler.py) --------------------
     def sched_submit(self, prompt_tokens: list[int], max_tokens: int, *,
                      temperature: float, top_p: float, eos_id: int,
-                     deadline: float | None):
+                     deadline: float | None, stop: list[str] | None = None):
         """Validate and submit one request to the slot scheduler.  Split
         from :meth:`sched_drain` so streaming handlers can 400/429/503
         BEFORE committing to SSE headers.  Raises ContextOverflow /
-        SchedulerClosed / SchedulerSaturated."""
+        SchedulerClosed / SchedulerSaturated.  ``stop`` strings ride the
+        ticket so a drain-time hand-off export can ship them (the
+        importing replica owes the client the same stop-scan)."""
         eng = self.scheduler.engine
         if not prompt_tokens:
             raise ContextOverflow("a prompt encoded to zero tokens")
@@ -1103,9 +1146,11 @@ class ApiState:
         max_new = eng.seq_len - len(prompt_tokens)
         if max_tokens > 0:
             max_new = min(max_new, max_tokens)
-        return self.scheduler.submit(
+        ticket = self.scheduler.submit(
             prompt_tokens, max_new, temperature=temperature, top_p=top_p,
             eos_ids=(eos_id,), deadline=self.effective_deadline(deadline))
+        ticket.stop = [str(s) for s in stop or []]
+        return ticket
 
     def sched_drain(self, ticket, prev: int, *, stop: list[str], emit,
                     is_aborted=None) -> tuple[str, int, str]:
@@ -1162,6 +1207,75 @@ class ApiState:
         push(buf, finish)
         return "".join(parts), n_comp, finish
 
+    def handoff_resume(self, ticket, extra: dict, emitted_chars: int,
+                       emit, is_aborted=None) -> tuple[str, int, str]:
+        """Drive an imported hand-off request to completion (the
+        ``/admin/import`` twin of :meth:`sched_drain`).
+
+        The exporter's completion tokens (``extra["completion"]``) are
+        replayed through a fresh incremental UTF-8 decoder so the decode
+        and stop-scan state land exactly where the exporter's stream
+        stood; only text beyond ``emitted_chars`` — the characters the
+        router already forwarded to the client — is emitted.  The client
+        therefore sees one seamless stream across the replica move.
+        Returns ``(full_completion_text, total_completion_tokens,
+        finish)``; token totals include the replayed tokens, so usage
+        accounting survives the hop."""
+        import codecs
+        tok = self.tokenizer
+        stop = [str(s) for s in extra.get("stop") or []]
+        hold = max((len(s) for s in stop), default=0)
+        dec = codecs.getincrementaldecoder("utf-8")("replace")
+        prompt = [int(x) for x in extra["prompt"]]
+        replay = [int(x) for x in extra.get("completion") or []]
+        prev = prompt[-1]
+        full = ""
+        cursor = max(0, int(emitted_chars))
+
+        def feed(t):
+            nonlocal full, prev
+            full += dec.decode(tok.decode_piece(prev, t))
+            prev = t
+
+        def flush(limit, finish=None):
+            nonlocal cursor
+            delta = full[cursor:limit] if limit > cursor else ""
+            if delta or finish is not None:
+                emit(delta, finish)
+            cursor = max(cursor, limit)
+
+        for t in replay:
+            feed(t)
+        n_comp = len(replay)
+        stopped = False
+        for t in ticket.tokens():
+            if is_aborted is not None and is_aborted():
+                ticket.cancel("aborted")
+                break
+            n_comp += 1
+            feed(t)
+            # global stop-scan: a stop wholly inside the exporter's
+            # already-emitted prefix cannot exist (its own hold-back scan
+            # would have fired), so any cut found here is new text
+            cuts = [c for c in (full.find(s) for s in stop) if c != -1]
+            if cuts:
+                ticket.cancel("stop")
+                flush(min(cuts), "stop")
+                stopped = True
+                break
+            flush(len(full) - (hold - 1) if hold else len(full))
+        if stopped:
+            return full[:cursor], n_comp, "stop"
+        finish = ticket.finish or "aborted"
+        full += dec.decode(b"", True)
+        cuts = [c for c in (full.find(s) for s in stop) if c != -1]
+        limit = len(full)
+        if cuts:
+            limit = min(cuts)
+            finish = "stop"
+        flush(limit, finish)
+        return full[:limit], n_comp, finish
+
 
 def make_handler(state: ApiState):
     class Handler(BaseHTTPRequestHandler):
@@ -1189,6 +1303,11 @@ def make_handler(state: ApiState):
             client = self.headers.get("X-Request-Id") or ""
             rid = _RID_RE.sub("", client)[:_RID_MAX] or new_request_id()
             self._rid = rid
+            # router→replica hops stamp X-Dllama-Hop (the router's hop
+            # id) so this replica's flight record for the request links
+            # back to the router-side ring (fleet correlation satellite)
+            hop = self.headers.get("X-Dllama-Hop") or ""
+            self._hop = _RID_RE.sub("", hop)[:_RID_MAX] or None
             set_request_id(rid)
             return rid
 
@@ -1217,6 +1336,19 @@ def make_handler(state: ApiState):
 
         def _text(self, code: int, text: str, content_type: str):
             data = text.encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self._rid_header()
+            if state.draining:
+                self.close_connection = True
+            self.end_headers()
+            try:
+                self.wfile.write(data)
+            except OSError:
+                self.close_connection = True
+
+        def _bytes(self, code: int, data: bytes, content_type: str):
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(data)))
@@ -1477,6 +1609,20 @@ def make_handler(state: ApiState):
                                               f"request id {rid!r}"})
                 else:
                     self._json(200, rec)
+            elif path.startswith("/admin/export/"):
+                # drain-time hand-off pickup (fleet router): one-shot —
+                # the record leaves this process with the response, so a
+                # double-fetch cannot resume the same request twice
+                rid = path[len("/admin/export/"):]
+                rec = state.handoff_records.pop(rid, None)
+                if rec is None:
+                    self._json(404, {"error": f"no hand-off record for "
+                                              f"request id {rid!r}"})
+                else:
+                    obs_metrics.HANDOFF_EXPORTS.inc()
+                    _log.info("handoff_export_served", extra={
+                        "bytes": len(rec)})
+                    self._bytes(200, rec, "application/octet-stream")
             elif path == "/debug/timeline":
                 # slot timeline + goodput decomposition (obs/flight.py +
                 # scheduler accounting); trace_dump.py --slots renders it
@@ -1599,14 +1745,14 @@ def make_handler(state: ApiState):
             return True
 
         def _submit_or_reject(self, ids, max_tokens, *, temperature,
-                              top_p, eos_id, deadline):
+                              top_p, eos_id, deadline, stop=None):
             """sched_submit with every refusal mapped to its HTTP answer
             (the same codes the mutex path's admission uses).  Returns
             the ticket, or None when a response was already sent."""
             try:
                 return state.sched_submit(
                     ids, max_tokens, temperature=temperature, top_p=top_p,
-                    eos_id=eos_id, deadline=deadline)
+                    eos_id=eos_id, deadline=deadline, stop=stop)
             except ContextOverflow as e:
                 self._json(400, state.overflow_body(e))
             except SchedulerSaturated as e:
@@ -1652,7 +1798,7 @@ def make_handler(state: ApiState):
             # refusals answer with their proper status codes
             ticket = self._submit_or_reject(
                 ids, max_tokens, temperature=temperature, top_p=top_p,
-                eos_id=eos_id, deadline=deadline)
+                eos_id=eos_id, deadline=deadline, stop=stop)
             if ticket is None:
                 return
             created = int(time.time())
@@ -1751,7 +1897,7 @@ def make_handler(state: ApiState):
             ticket = self._submit_or_reject(
                 ids, params.max_tokens, temperature=params.temperature,
                 top_p=params.top_p, eos_id=tok.chat_eos_id,
-                deadline=deadline)
+                deadline=deadline, stop=stops)
             if ticket is None:
                 return
             created = int(time.time())
@@ -1822,11 +1968,129 @@ def make_handler(state: ApiState):
                           "completion_tokens": n_comp,
                           "total_tokens": len(ids) + n_comp}})
 
+        def _admin_import(self, query: str):
+            """``POST /admin/import?emitted_chars=N`` — re-bind a DLREQ01
+            hand-off record (octet-stream body) into a free slot and
+            stream the request's remaining completion back as
+            text_completion-shaped SSE deltas (the router adapts the
+            shape for chat/non-streaming clients).  ``emitted_chars`` is
+            how many completion characters the router already forwarded
+            to the client from the exporting replica; only text beyond
+            it is emitted.  409 on geometry mismatch so the router can
+            try another peer."""
+            if not state.handoff:
+                self._json(404, {"error": "hand-off is not enabled "
+                                          "(--handoff)"})
+                return
+            q = parse_qs(query)
+            try:
+                emitted_chars = max(0, int(q.get("emitted_chars",
+                                                 ["0"])[0]))
+            except ValueError:
+                emitted_chars = 0
+            try:
+                length = int(self.headers.get("Content-Length", 0) or 0)
+            except (TypeError, ValueError):
+                self._json(400, {"error": "bad Content-Length"})
+                return
+            if length > MAX_HANDOFF_BYTES:
+                self.close_connection = True
+                self._json(413, {"error": "hand-off record too large"})
+                return
+            if length <= 0:
+                self._json(400, {"error": "hand-off record body required"})
+                return
+            try:
+                raw = self.rfile.read(length)
+            except TimeoutError:
+                state.metrics.bump("read_timeouts_408")
+                self.close_connection = True
+                self._json(408, {"error": "timed out reading hand-off "
+                                          "record"})
+                return
+            if len(raw) < length:
+                state.metrics.bump("client_disconnects")
+                self.close_connection = True
+                return
+            try:
+                ticket, extra = state.scheduler.import_request(raw)
+            except SnapshotMismatch as e:
+                obs_metrics.HANDOFF_IMPORT_REJECTS.inc()
+                self._json(409, {"error": str(e)})
+                return
+            except ArtifactError as e:
+                obs_metrics.HANDOFF_IMPORT_REJECTS.inc()
+                self._json(400, {"error": str(e)})
+                return
+            except ContextOverflow as e:
+                self._json(400, state.overflow_body(e))
+                return
+            except SchedulerSaturated as e:
+                state.metrics.bump("requests_rejected_429")
+                self._json(429, state.overflow_body(e),
+                           headers={"Retry-After": state.retry_after_hint()})
+                return
+            except SchedulerClosed:
+                state.metrics.bump("requests_rejected_503")
+                self._json(503, {"error": "server is draining; "
+                                          "no new requests accepted"},
+                           headers={"Retry-After": 30})
+                return
+            obs_metrics.HANDOFF_IMPORTS.inc()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self._rid_header()
+            self.end_headers()
+            aborted = [False]
+
+            def emit(delta, finish):
+                if aborted[0]:
+                    return
+                try:
+                    chunk = {"object": "text_completion",
+                             "model": state.model_name,
+                             "choices": [{"text": delta, "index": 0,
+                                          "finish_reason": finish,
+                                          "logprobs": None}]}
+                    self.wfile.write(
+                        f"data: {json.dumps(chunk)}\n\n".encode())
+                    self.wfile.flush()
+                except OSError:
+                    aborted[0] = True
+                    state.metrics.bump("client_disconnects")
+
+            state.mark_active(True)
+            try:
+                text, n_comp, finish = state.handoff_resume(
+                    ticket, extra, emitted_chars, emit,
+                    is_aborted=lambda: aborted[0])
+            except Exception as e:
+                ticket.cancel("aborted")
+                err = {"error": {"message": str(e),
+                                 "type": "server_error"}}
+                self._safe_write(f"data: {json.dumps(err)}\n\n".encode()
+                                 + b"data: [DONE]\n\n", aborted)
+                raise
+            finally:
+                state.mark_active(False)
+            usage = {"object": "handoff.usage",
+                     "usage": {"prompt_tokens": len(extra.get("prompt")
+                                                    or []),
+                               "completion_tokens": n_comp,
+                               "finish_reason": finish}}
+            self._safe_write(f"data: {json.dumps(usage)}\n\n".encode()
+                             + b"data: [DONE]\n\n", aborted)
+
         def do_POST(self):
             self._begin_request()
             ppath, _, pquery = self.path.partition("?")
             if ppath == "/debug/profile":
                 self._debug_profile(pquery)
+                return
+            if ppath == "/admin/import":
+                self._admin_import(pquery)
                 return
             if self.path not in ("/v1/chat/completions", "/v1/completions"):
                 self._json(404, {"error": "not found"})
@@ -1859,7 +2123,11 @@ def make_handler(state: ApiState):
             timer = _StreamTimer(rid=self._rid)
             # flight record opens at admission; the scheduler path merges
             # its per-dispatch detail into this same record by request ID
-            obs_flight.submit(self._rid, path=self.path)
+            # (hop = the router's ring id, for cross-fleet correlation)
+            if getattr(self, "_hop", None):
+                obs_flight.submit(self._rid, path=self.path, hop=self._hop)
+            else:
+                obs_flight.submit(self._rid, path=self.path)
             ok = False
             try:
                 locked = False
@@ -2124,7 +2392,24 @@ def serve(state: ApiState, host: str = "0.0.0.0", port: int = 9990, *,
             _log.info("draining", extra={
                 "signal": signal.Signals(signum).name,
                 "grace_s": round(state.drain_grace, 1)})
-            threading.Thread(target=server.shutdown, daemon=True).start()
+
+            def _shutdown():
+                # hand-off records are PULLED: the router learns of the
+                # drain from the finish_reason="handoff" stream chunks
+                # and then GETs /admin/export/<rid> on a NEW connection.
+                # shutdown() stops accepting new connections, so it must
+                # wait (bounded by the drain deadline) until every
+                # exported record has been picked up
+                deadline = state.drain_deadline or time.monotonic()
+                while state.handoff_records and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.05)
+                if state.handoff_records:
+                    _log.warning("handoff_records_unclaimed", extra={
+                        "count": len(state.handoff_records)})
+                server.shutdown()
+
+            threading.Thread(target=_shutdown, daemon=True).start()
         signal.signal(signal.SIGTERM, _drain)
         signal.signal(signal.SIGINT, _drain)
     _log.info("listening", extra={"host": host, "port": port})
@@ -2222,7 +2507,7 @@ def main(argv=None):
                      drain_grace=args.drain_grace,
                      snapshot_dir=args.snapshot_dir,
                      scheduler=scheduler,
-                     slo=slo)
+                     slo=slo, handoff=getattr(args, "handoff", False))
     if args.snapshot_dir:
         state.restore_snapshot()
     try:

@@ -1,0 +1,189 @@
+"""Fleet-router tests (router/registry.py scoring + hysteresis, and the
+one-command fleet smoke from tools/router_smoke.py wired as a fast-tier
+test).
+
+The registry tests run against an in-thread fake replica serving canned
+``/health`` JSON — no jax, no subprocesses — and pin:
+
+* **scoring** — dispatch prefers free slots, debits queue depth and
+  router-side in-flight, tiebreaks on free KV pages, and buries
+  degraded / SLO-violating replicas under a penalty that only loses to
+  the same penalty;
+* **eligibility** — ejected, draining, and never-probed backends take
+  no traffic; hand-off placement additionally requires the replica to
+  advertise ``capacity.handoff``;
+* **hysteresis** — ``eject_after`` consecutive failures (probe or
+  dispatch) eject; ``readmit_after`` consecutive healthy probes
+  re-admit; one good probe does not un-eject and one failure does not
+  eject.
+
+The smoke test boots 2 real replicas + the router as subprocesses and
+asserts zero errors with balanced dispatch — the cheapest end-to-end
+proof of the fleet path (probes, least-loaded pick, relay, metrics).
+"""
+
+import http.server
+import json
+import threading
+
+import pytest
+
+from fixtures import REPO, free_port, write_tiny_model, write_tiny_tokenizer
+from dllama_tpu.router.registry import Backend, Registry
+
+pytestmark = pytest.mark.router
+
+
+def _health(free_slots=2, queue_depth=0, free_kv_pages=50, handoff=True,
+            degraded=False, slo="ok", status="serving"):
+    return {"status": status, "degraded": degraded,
+            "slo": {"status": slo},
+            "capacity": {"free_slots": free_slots,
+                         "queue_depth": queue_depth,
+                         "free_kv_pages": free_kv_pages,
+                         "handoff": handoff}}
+
+
+def _backend(health=None, probed=True):
+    b = Backend(f"127.0.0.1:{free_port()}")
+    if probed:
+        b.last_health = health if health is not None else _health()
+    return b
+
+
+# -- scoring and eligibility ----------------------------------------------
+
+def test_score_prefers_idle_capacity():
+    idle = _backend(_health(free_slots=3))
+    busy = _backend(_health(free_slots=1, queue_depth=2))
+    assert Registry._score(idle) > Registry._score(busy)
+    # router-side in-flight debits the score before the next probe lands
+    idle.in_flight = 5
+    assert Registry._score(idle) < Registry._score(busy)
+
+
+def test_score_kv_pages_tiebreak_only():
+    roomy = _backend(_health(free_slots=2, free_kv_pages=60))
+    tight = _backend(_health(free_slots=2, free_kv_pages=2))
+    assert Registry._score(roomy) > Registry._score(tight)
+    # …but a page never outweighs a slot
+    assert Registry._score(_backend(_health(free_slots=1, free_kv_pages=0))) \
+        > Registry._score(_backend(_health(free_slots=0, free_kv_pages=9e9)))
+
+
+def test_score_penalizes_degraded_and_slo_violating():
+    good = _backend(_health(free_slots=0, queue_depth=5))
+    for sick in (_backend(_health(free_slots=8, degraded=True)),
+                 _backend(_health(free_slots=8, slo="violating"))):
+        assert Registry._score(sick) < Registry._score(good)
+
+
+def test_pick_eligibility():
+    reg = Registry(["127.0.0.1:1", "127.0.0.1:2", "127.0.0.1:3",
+                    "127.0.0.1:4", "127.0.0.1:5"])
+    best, drn, eject, unprobed, worse = reg.backends
+    best.last_health = _health(free_slots=3)
+    drn.last_health = _health(free_slots=9, status="draining")
+    eject.last_health = _health(free_slots=9)
+    eject.ejected = True
+    worse.last_health = _health(free_slots=1, handoff=False)
+    assert unprobed.last_health is None
+    assert reg.pick() is best
+    assert reg.pick(exclude=(best,)) is worse
+    assert reg.pick(exclude=(best, worse)) is None
+    # hand-off placement additionally requires capacity.handoff
+    assert reg.handoff_peers() == [best]
+    assert reg.handoff_peers(exclude=(best,)) == []
+
+
+def test_ejection_and_failure_hysteresis():
+    reg = Registry(["127.0.0.1:1", "127.0.0.1:2"], eject_after=3)
+    b = reg.backends[0]
+    b.last_health = _health()
+    reg.record_failure(b)
+    reg.record_failure(b)
+    assert not b.ejected  # two failures are not three
+    reg.record_success(b)  # a served request resets the streak
+    reg.record_failure(b)
+    reg.record_failure(b)
+    assert not b.ejected
+    reg.record_failure(b)
+    assert b.ejected
+    assert reg.pick() is None  # sibling was never probed
+
+
+# -- probe loop against a fake replica ------------------------------------
+
+class _FakeReplica:
+    """In-thread HTTP server returning a settable /health payload (or a
+    5xx when told to be sick)."""
+
+    def __init__(self):
+        self.payload = _health()
+        self.sick = False
+        outer = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                body = json.dumps(outer.payload).encode()
+                self.send_response(503 if outer.sick else 200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_probe_eject_readmit_cycle():
+    replica = _FakeReplica()
+    try:
+        reg = Registry([f"127.0.0.1:{replica.port}"],
+                       eject_after=2, readmit_after=2, probe_timeout=2.0)
+        b = reg.backends[0]
+        assert reg.probe(b)
+        assert b.last_health["capacity"]["free_slots"] == 2
+        assert b.last_probe_s is not None and reg.pick() is b
+
+        replica.sick = True
+        assert not reg.probe(b) and not b.ejected  # 1 failure: hysteresis
+        assert not reg.probe(b) and b.ejected      # 2nd ejects
+        assert reg.pick() is None
+
+        replica.sick = False
+        assert reg.probe(b) and b.ejected          # 1 good probe: still out
+        assert reg.probe(b) and not b.ejected      # 2nd re-admits
+        assert reg.pick() is b
+    finally:
+        replica.close()
+
+
+# -- end-to-end fleet smoke -----------------------------------------------
+
+def test_fleet_smoke(tmp_path):
+    """tools/router_smoke.py: router + 2 real replicas, 8 concurrent
+    requests, zero errors, every backend served at least one."""
+    import os
+    import sys
+
+    tools = os.path.join(REPO, "tools")
+    sys.path.insert(0, tools)
+    try:
+        from router_smoke import run_smoke
+    finally:
+        sys.path.remove(tools)
+    model = str(tmp_path / "tiny.model.json")
+    tok = str(tmp_path / "tiny.tok.json")
+    write_tiny_model(model)
+    write_tiny_tokenizer(tok)
+    run_smoke(model, tok, n_requests=8, n_replicas=2)

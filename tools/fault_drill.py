@@ -67,9 +67,11 @@ class Server:
 
     def __init__(self, model: str, tokenizer: str, *, faults: str = "",
                  extra_flags: list[str] | None = None,
-                 env_extra: dict | None = None):
+                 env_extra: dict | None = None, port: int | None = None):
         from fixtures import cpu_env, free_port
-        self.port = free_port()
+        # a fixed port lets the failover drill restart a replica at the
+        # address the router already knows (allow_reuse_address rebinds)
+        self.port = port if port is not None else free_port()
         self.base = f"http://127.0.0.1:{self.port}"
         env = cpu_env()
         if faults:
@@ -489,6 +491,166 @@ def drill_slo_burn(model, tok):
         s.stop()
 
 
+class Router:
+    """The fleet router subprocess (python -m dllama_tpu.router) — no
+    model load, so it is up in well under a second."""
+
+    def __init__(self, backends: list[int], **flags):
+        from fixtures import free_port
+        self.port = free_port()
+        self.base = f"http://127.0.0.1:{self.port}"
+        argv = [sys.executable, "-m", "dllama_tpu.router",
+                "--backends", ",".join(f"127.0.0.1:{p}" for p in backends),
+                "--port", str(self.port)]
+        for k, v in flags.items():
+            argv += [f"--{k.replace('_', '-')}", str(v)]
+        self.proc = subprocess.Popen(argv, cwd=REPO, stdout=subprocess.PIPE,
+                                     stderr=subprocess.STDOUT, text=True)
+
+    def wait_ready(self, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(f"router died:\n{self.proc.stdout.read()}")
+            try:
+                urllib.request.urlopen(self.base + "/health", timeout=1)
+                return
+            except OSError:
+                time.sleep(0.1)
+        raise RuntimeError("router did not come up")
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+        self.proc.wait()
+
+
+def drill_replica_failover(model, tok):
+    """SIGKILL one of two replicas behind the router mid-decode: the
+    in-flight stream finishes with finish_reason="replica_lost", fresh
+    not-yet-streamed requests retry onto the survivor with zero errors,
+    the dead backend ejects, and it re-admits after a restart."""
+    flags = ["--batch-slots", "2", "--kv-pages", "64", "--kv-page-size",
+             "4", "--io-timeout", "30"]
+    a = Server(model, tok, faults="engine.device_step=delay:0.25",
+               extra_flags=flags)
+    b = Server(model, tok, faults="engine.device_step=delay:0.25",
+               extra_flags=flags)
+    router = None
+    restarted = None
+    try:
+        a.wait_ready()
+        b.wait_ready()
+        router = Router([a.port, b.port], probe_interval=0.5,
+                        eject_after=2, readmit_after=2, router_retries=3)
+        router.wait_ready()
+        time.sleep(1.2)  # one probe round so both backends are scored
+
+        stream_result: dict = {}
+
+        def run_stream():
+            req = urllib.request.Request(
+                router.base + "/v1/completions",
+                json.dumps({"prompt": "Once upon a time",
+                            "max_tokens": 48, "stream": True}).encode(),
+                headers={"Content-Type": "application/json"})
+            text, finish = "", None
+            with urllib.request.urlopen(req, timeout=240) as r:
+                for line in r:
+                    line = line.strip()
+                    if not line.startswith(b"data: "):
+                        continue
+                    payload = line[len(b"data: "):]
+                    if payload == b"[DONE]":
+                        break
+                    evt = json.loads(payload)
+                    c = evt["choices"][0]
+                    text += c.get("text") or ""
+                    stream_result["chars"] = len(text)
+                    if c.get("finish_reason"):
+                        finish = c["finish_reason"]
+            stream_result.update(text=text, finish=finish)
+
+        st = threading.Thread(target=run_stream)
+        st.start()
+        # wait for content to reach the CLIENT (a kill before first byte
+        # would be retried invisibly — correct, but not this drill), then
+        # find the replica actually decoding the stream
+        victim = survivor = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if stream_result.get("chars", 0) < 1:
+                time.sleep(0.05)
+                continue
+            for srv, other in ((a, b), (b, a)):
+                try:
+                    h = get(srv.base, "/health")
+                except OSError:
+                    continue
+                if (h.get("scheduler") or {}).get("active", 0) >= 1:
+                    victim, survivor = srv, other
+                    break
+            if victim is not None:
+                break
+            time.sleep(0.05)
+        assert victim is not None, "stream never became active"
+        victim.proc.kill()  # SIGKILL: no drain, no hand-off — a crash
+
+        # queued (not-yet-streamed) requests must retry cleanly: some of
+        # these dispatch to the dead replica before the probes eject it
+        results: list = []
+
+        def run_quick():
+            try:
+                with post_to(router.base, "/v1/completions",
+                             {"prompt": "hi", "max_tokens": 2},
+                             timeout=240) as r:
+                    results.append(json.loads(r.read()))
+            except Exception as e:  # noqa: BLE001 — the assert reports it
+                results.append(e)
+
+        qs = [threading.Thread(target=run_quick) for _ in range(4)]
+        for t in qs:
+            t.start()
+        for t in qs:
+            t.join(240)
+        st.join(240)
+        errors = [r for r in results if not isinstance(r, dict)]
+        assert not errors, f"queued requests must not error: {errors}"
+        bad = [r for r in results
+               if r["choices"][0]["finish_reason"] not in ("stop", "length")]
+        assert not bad, bad
+        assert stream_result.get("finish") == "replica_lost", stream_result
+        m = get(router.base, "/metrics")
+        vkey = f"127.0.0.1:{victim.port}"
+        assert m.get("router_ejections", {}).get(vkey, 0) >= 1, m
+        assert m.get("router_replica_lost", 0) >= 1, m
+
+        # restart the victim at the same address → hysteretic re-admission
+        restarted = Server(model, tok,
+                           faults="engine.device_step=delay:0.25",
+                           extra_flags=flags, port=victim.port)
+        restarted.wait_ready()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            rows = {r["addr"]: r for r in
+                    get(router.base, "/health")["backends"]}
+            if not rows[vkey]["ejected"]:
+                break
+            time.sleep(0.25)
+        else:
+            raise AssertionError("restarted replica never re-admitted")
+        assert get(router.base, "/metrics") \
+            .get("router_readmits", {}).get(vkey, 0) >= 1
+    finally:
+        if router is not None:
+            router.stop()
+        if restarted is not None:
+            restarted.stop()
+        a.stop()
+        b.stop()
+
+
 DRILLS = {
     "deadline": drill_deadline,
     "disconnect": drill_disconnect,
@@ -501,6 +663,7 @@ DRILLS = {
     "slot_churn": drill_slot_churn,
     "page_exhaustion": drill_page_exhaustion,
     "slo_burn": drill_slo_burn,
+    "replica_failover": drill_replica_failover,
 }
 
 

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""One-command local fleet smoke: router + 2 tiny replicas, 8 clients.
+
+Boots two ``dllama-api`` replicas on the tests' tiny synthetic model,
+fronts them with the fleet router, fires 8 concurrent completions, and
+asserts (a) zero errors and (b) balanced dispatch — every backend served
+at least one request (read from the router's ``router_dispatch`` metric
+family).  This is the cheapest end-to-end proof that the fleet path
+works on this machine: registry probes, least-loaded dispatch, relay,
+metrics.
+
+Usage::
+
+    python tools/router_smoke.py            # 8 requests, 2 replicas
+    python tools/router_smoke.py -n 16
+
+Exit code 0 iff the smoke passed.  CPU-only and fast-tier — wired into
+tests/test_router.py under the ``router`` marker.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))  # tiny-model fixtures
+
+
+def _wait_ready(proc, base: str, timeout: float = 120.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"process died:\n{proc.stdout.read() if proc.stdout else ''}")
+        try:
+            urllib.request.urlopen(base + "/health", timeout=1)
+            return
+        except OSError:
+            time.sleep(0.2)
+    raise RuntimeError(f"{base} did not come up")
+
+
+def run_smoke(model: str, tok: str, *, n_requests: int = 8,
+              n_replicas: int = 2) -> None:
+    from fixtures import cpu_env, free_port
+    env = cpu_env()
+    replicas = []
+    try:
+        for _ in range(n_replicas):
+            port = free_port()
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "dllama_tpu.server.api",
+                 "--model", model, "--tokenizer", tok,
+                 "--port", str(port), "--temperature", "0",
+                 "--max-seq-len", "64", "--batch-slots", "2",
+                 "--kv-pages", "64", "--kv-page-size", "4"],
+                cwd=REPO, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+            replicas.append((port, proc))
+        router_port = free_port()
+        router = subprocess.Popen(
+            [sys.executable, "-m", "dllama_tpu.router",
+             "--backends",
+             ",".join(f"127.0.0.1:{p}" for p, _ in replicas),
+             "--port", str(router_port), "--probe-interval", "0.5"],
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        replicas.append((router_port, router))
+        for port, proc in replicas:
+            _wait_ready(proc, f"http://127.0.0.1:{port}")
+        base = f"http://127.0.0.1:{router_port}"
+        time.sleep(1.2)  # a probe round, so every backend is scored
+
+        results: list = []
+
+        def one(i: int) -> None:
+            body = json.dumps({"prompt": f"request {i} says hello",
+                               "max_tokens": 4}).encode()
+            req = urllib.request.Request(
+                base + "/v1/completions", body,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=240) as r:
+                    results.append(json.loads(r.read()))
+            except Exception as e:  # noqa: BLE001 — reported below
+                results.append(e)
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(n_requests)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(240)
+        wall = time.monotonic() - t0
+
+        errors = [r for r in results if not isinstance(r, dict)]
+        if errors:
+            raise AssertionError(f"{len(errors)}/{n_requests} requests "
+                                 f"failed: {errors[:3]}")
+        bad = [r for r in results
+               if r["choices"][0]["finish_reason"] not in ("stop", "length")]
+        if bad:
+            raise AssertionError(f"unexpected finishes: {bad[:3]}")
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            metrics = json.loads(r.read())
+        dispatch = metrics.get("router_dispatch") or {}
+        idle = [f"127.0.0.1:{p}" for p, _ in replicas[:-1]
+                if not dispatch.get(f"127.0.0.1:{p}")]
+        if idle:
+            raise AssertionError(
+                f"dispatch was not balanced — {idle} served nothing "
+                f"(router_dispatch={dispatch})")
+        print(f"✅ fleet smoke: {n_requests} requests, 0 errors, "
+              f"dispatch {dispatch}, {wall:.1f}s")
+    finally:
+        for _, proc in replicas:
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-n", "--requests", type=int, default=8)
+    ap.add_argument("--replicas", type=int, default=2)
+    args = ap.parse_args(argv)
+    import tempfile
+
+    from fixtures import write_tiny_model, write_tiny_tokenizer
+    with tempfile.TemporaryDirectory() as d:
+        model, tok = os.path.join(d, "tiny.m"), os.path.join(d, "tiny.t")
+        write_tiny_model(model)
+        write_tiny_tokenizer(tok)
+        try:
+            run_smoke(model, tok, n_requests=args.requests,
+                      n_replicas=args.replicas)
+        except AssertionError as e:
+            print(f"❌ {e}")
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
