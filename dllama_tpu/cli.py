@@ -181,6 +181,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "are still pooled; nothing is shared or retained "
                         "across requests) — A/B baseline for "
                         "prefix_tokens_reused metrics")
+    p.add_argument("--no-sched-overlap", action="store_true",
+                   help="slot scheduler: disable the two-deep overlapped "
+                        "dispatch pipeline (device-fed speculative decode "
+                        "bursts) and dispatch fully synchronously — debug "
+                        "switch and A/B baseline; greedy output is "
+                        "byte-identical either way (docs/PERF.md)")
     # ---- serving robustness (api server; docs/ROBUSTNESS.md) ----
     p.add_argument("--host", default="0.0.0.0",
                    help="api server: bind address (default 0.0.0.0)")

@@ -231,9 +231,19 @@ class SlotTimeline:
                     host_gap_ms: float = 0.0, idle_ms: float = 0.0,
                     steps: int = 1, t_width: int = 1,
                     slots: list[dict] | None = None,
-                    error: bool = False) -> None:
+                    error: bool = False, overlapped: bool = False,
+                    hidden_host_ms: float = 0.0,
+                    discarded: bool = False) -> None:
         """``ts`` is the dispatch-start ``perf_counter`` (the span clock,
-        so ``--slots`` tracks align with the request spans in Perfetto)."""
+        so ``--slots`` tracks align with the request spans in Perfetto).
+
+        ``overlapped`` marks a dispatch that was already enqueued on
+        device while its predecessor landed; its ``hidden_host_ms`` is
+        the host-side gap the device outlived (reported here and in the
+        hidden-gap counter, NOT silently dropped — and not double-counted
+        into ``host_gap_ms``, which stays the *exposed* gap).
+        ``discarded`` marks a speculative dispatch thrown away at a
+        pipeline flush point: its tokens were never fanned out."""
         with self._lock:
             self._seq += 1
             entry = {"seq": self._seq, "t": round(time.time(), 6),
@@ -241,11 +251,15 @@ class SlotTimeline:
                      "host_gap_ms": round(host_gap_ms, 3),
                      "idle_ms": round(idle_ms, 3),
                      "steps": steps, "t_width": t_width,
+                     "overlapped": bool(overlapped),
+                     "hidden_host_ms": round(hidden_host_ms, 3),
                      "slots": slots or []}
             if device_ms is not None:
                 entry["device_ms"] = round(device_ms, 3)
             if error:
                 entry["error"] = True
+            if discarded:
+                entry["discarded"] = True
             self._steps.append(entry)
 
     def snapshot(self, n: int | None = None) -> list[dict]:

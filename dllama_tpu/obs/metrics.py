@@ -624,6 +624,30 @@ SCHED_HOST_GAP_MS = REGISTRY.histogram(
     "excluding idle sleep — the dispatch overhead ROADMAP item 3 "
     "(on-device multi-step decode) would amortize.")
 
+# overlapped dispatch pipeline (runtime/scheduler.py --sched-overlap):
+# dispatch N+1 is enqueued on device while dispatch N's tokens transfer
+# and fan out host-side.  Host work the device outlived is HIDDEN (not in
+# the goodput components — the device never waited); host work that
+# outlived the device stays exposed host_gap.  The ratio/depth gauges
+# make the pipeline state observable; a forced flush drives depth to 0.
+SCHED_OVERLAP_RATIO = REGISTRY.gauge(
+    "sched_overlap_ratio",
+    "Fraction of scheduler dispatches enqueued while their predecessor "
+    "was still in flight (cumulative since start).")
+SCHED_INFLIGHT_DEPTH = REGISTRY.gauge(
+    "sched_inflight_depth",
+    "Scheduler dispatches enqueued on device but not yet landed "
+    "(2 while the pipeline is full, 0 after a flush).")
+SCHED_HOST_GAP_HIDDEN_MS = REGISTRY.counter(
+    "sched_host_gap_hidden_ms",
+    "Host-side dispatch-gap milliseconds hidden behind device execution "
+    "by the overlapped pipeline (reported separately, never double-"
+    "counted into sched_step_time_ms components).")
+SCHED_OVERLAP_DISCARDS = REGISTRY.counter(
+    "sched_overlap_discards",
+    "Speculative dispatches landed and thrown away at a pipeline flush "
+    "point (admission, retire, cancel/deadline, drain, hand-off export).")
+
 # SLO burn-rate engine (obs/slo.py): burn = observed bad fraction over a
 # rolling window / allowed bad fraction; >= 1.0 means the error budget is
 # burning faster than the objective permits.

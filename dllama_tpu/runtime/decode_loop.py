@@ -123,8 +123,12 @@ def slot_chunk(params, cfg: ModelConfig, cache: KVCache, tokens: jax.Array,
     only when no slot is mid-prefill; free rows ride along at position 0
     and their samples are discarded host-side.
 
-    Returns (tokens (steps, B), cache).  The caller advances per-slot
-    positions host-side (``pos += n_valid``, then +1 per extra step).
+    Returns (tokens (steps, B), cache, last (B,)).  ``last`` is the
+    final sampled row — the same values as ``tokens[-1]``, surfaced as
+    its own output so a pipelined caller can feed it straight into the
+    next dispatch as a device array (no device→host→device round trip
+    in pure decode).  The caller advances per-slot positions host-side
+    (``pos += n_valid``, then +1 per extra step).
 
     ``page_table`` (B, max_pages) switches the cache to a paged pool:
     pages are pre-reserved at admission for the whole request (prompt +
@@ -147,9 +151,9 @@ def slot_chunk(params, cfg: ModelConfig, cache: KVCache, tokens: jax.Array,
         return (cache, nxt, pos_rows + 1, key), nxt
 
     if steps > 1:
-        (cache, _, _, _), rest = jax.lax.scan(
+        (cache, last, _, _), rest = jax.lax.scan(
             body, (cache, first, pos_rows, key), None, length=steps - 1)
         toks = jnp.concatenate([first[None], rest], axis=0)
     else:
-        toks = first[None]
-    return toks, cache
+        toks, last = first[None], first
+    return toks, cache, last

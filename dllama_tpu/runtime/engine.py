@@ -1077,24 +1077,36 @@ class Engine:
                 self._chunk_counter -= 1
 
     # ------------------------------------------------------------------
-    def slot_step(self, tokens_np: np.ndarray, pos_rows_np: np.ndarray,
-                  n_valid_np: np.ndarray, *, temps_np: np.ndarray,
-                  topps_np: np.ndarray, steps: int = 1,
-                  page_tables_np: np.ndarray | None = None) -> np.ndarray:
-        """One continuous-batching dispatch over the slot-addressable
-        batch: row ``r`` consumes its first ``n_valid_np[r]`` tokens of
-        ``tokens_np`` (B, T) at its own cache positions
-        ``pos_rows_np[r]..``, then ``steps - 1`` pure decode steps run on
-        device (decode_loop.slot_chunk).  Returns the sampled ids
-        (steps, B).
+    def slot_step_async(self, tokens_np: np.ndarray | None,
+                        pos_rows_np: np.ndarray, n_valid_np: np.ndarray, *,
+                        temps_np: np.ndarray, topps_np: np.ndarray,
+                        steps: int = 1,
+                        page_tables_np: np.ndarray | None = None,
+                        feed_dev=None) -> "SlotDispatch":
+        """Enqueue one continuous-batching dispatch over the
+        slot-addressable batch WITHOUT blocking on the result: row ``r``
+        consumes its first ``n_valid_np[r]`` tokens of ``tokens_np``
+        (B, T) at its own cache positions ``pos_rows_np[r]..``, then
+        ``steps - 1`` pure decode steps run on device
+        (decode_loop.slot_chunk).  Returns a :class:`SlotDispatch`
+        completion handle holding the sampled-id futures; call
+        ``.wait()`` for the host (steps, B) array.
 
-        This is the primitive the slot scheduler
-        (runtime/scheduler.py) drives: a joining request's prefill chunk
-        and its neighbors' decode tokens share one dispatch, and a freed
-        slot is reused by just handing its row position 0 again — the
-        previous occupant's stale KV sits above the new request's causal
-        ceiling (see ops.attention.slot_gqa_attention_at), so per-slot
-        reset costs nothing.
+        This is the primitive the slot scheduler (runtime/scheduler.py)
+        drives: a joining request's prefill chunk and its neighbors'
+        decode tokens share one dispatch, and a freed slot is reused by
+        just handing its row position 0 again — the previous occupant's
+        stale KV sits above the new request's causal ceiling (see
+        ops.attention.slot_gqa_attention_at), so per-slot reset costs
+        nothing.
+
+        ``feed_dev`` is the device-resident feedback path: pass a prior
+        dispatch's ``last_dev`` (B,) and the new dispatch consumes it
+        directly as its T=1 token column — the sampled tokens never
+        visit the host on the input side, eliminating the
+        device→host→device round trip per pure-decode dispatch (the
+        paper's T ≈ 0 overlap goal applied to the host boundary).  With
+        ``feed_dev`` set, ``tokens_np`` must be None.
 
         Deliberately does NOT touch ``self.pos`` / ``self._offsets``:
         the one-shot conversation/batch paths and the slot path can share
@@ -1102,7 +1114,8 @@ class Engine:
         scheduler's ``exclusive()`` guarantees that), and the scheduler
         tracks every slot's clock host-side.  Compiled per
         ``(T, steps, all-greedy)``; temperature/top-p ride in as (B,)
-        arrays so heterogeneous requests share one program.
+        arrays so heterogeneous requests share one program — a
+        feed-fed dispatch shares the T=1 executable with a host-fed one.
 
         On a paged engine ``page_tables_np`` (B, max_pages) int32 is
         required: reads/writes indirect through it into the pool
@@ -1120,7 +1133,14 @@ class Engine:
             raise ValueError("paged engine: slot_step needs page_tables_np")
         if not self.paged and page_tables_np is not None:
             raise ValueError("page tables passed to a contiguous engine")
-        t = int(tokens_np.shape[1])
+        if feed_dev is not None:
+            if tokens_np is not None:
+                raise ValueError("feed_dev replaces tokens_np; pass one")
+            t = 1
+        elif tokens_np is None:
+            raise ValueError("slot step needs tokens_np or feed_dev")
+        else:
+            t = int(tokens_np.shape[1])
         if steps < 1:
             raise ValueError("steps must be positive")
         # dynamic_update_slice clamps out-of-range starts backwards, which
@@ -1144,20 +1164,24 @@ class Engine:
                         p, cfg, c, tok, pr, nv, k, tm, tp,
                         steps=steps, greedy=greedy, page_table=ptab),
                     donate_argnums=(1,),
-                    out_shardings=(self._rep, self._cache_sh))
+                    out_shardings=(self._rep, self._cache_sh, self._rep))
             else:
                 self._chunk_fns[key] = jax.jit(
                     lambda p, c, tok, pr, nv, k, tm, tp: slot_chunk(
                         p, cfg, c, tok, pr, nv, k, tm, tp,
                         steps=steps, greedy=greedy),
                     donate_argnums=(1,),
-                    out_shardings=(self._rep, self._cache_sh))
+                    out_shardings=(self._rep, self._cache_sh, self._rep))
         self._note_executable(fresh, key=key)
         fn = self._chunk_fns[key]
         sub = jax.random.fold_in(self._key, self._chunk_counter)
         self._chunk_counter += 1
         t0 = time.perf_counter()
-        args = (self.params, self.cache, jnp.asarray(tokens_np, jnp.int32),
+        if feed_dev is not None:
+            tok_arr = jnp.asarray(feed_dev, jnp.int32)[:, None]  # on device
+        else:
+            tok_arr = jnp.asarray(tokens_np, jnp.int32)
+        args = (self.params, self.cache, tok_arr,
                 jnp.asarray(pos_rows_np, jnp.int32),
                 jnp.asarray(n_valid_np, jnp.int32), sub,
                 jnp.asarray(temps_np, jnp.float32),
@@ -1165,16 +1189,20 @@ class Engine:
         if self.paged:
             args = args + (jnp.asarray(page_tables_np, jnp.int32),)
         with active_mesh(self.mesh):
-            toks_dev, self.cache = fn(*args)
-        self._sync(toks_dev, "slot step")
-        t1 = time.perf_counter()
-        if fresh:  # first call blocks through trace + compile
-            obs_metrics.ENGINE_COMPILE_S.observe(t1 - t0)
-        # device share of the dispatch, read by the scheduler's slot
-        # timeline (obs/flight.py) to split wall into device vs host
-        self.last_slot_dispatch_ms = (t1 - t0) * 1e3
-        obs_trace.record("slot_step", t0, t1, t=t, steps=steps)
-        return np.asarray(toks_dev)  # (steps, B)
+            toks_dev, self.cache, last_dev = fn(*args)
+        return SlotDispatch(self, toks_dev, last_dev, t=t, steps=steps,
+                            fresh=fresh, enqueued_at=t0)
+
+    def slot_step(self, tokens_np: np.ndarray, pos_rows_np: np.ndarray,
+                  n_valid_np: np.ndarray, *, temps_np: np.ndarray,
+                  topps_np: np.ndarray, steps: int = 1,
+                  page_tables_np: np.ndarray | None = None) -> np.ndarray:
+        """Synchronous :meth:`slot_step_async`: enqueue and immediately
+        wait.  Returns the sampled ids (steps, B)."""
+        return self.slot_step_async(
+            tokens_np, pos_rows_np, n_valid_np, temps_np=temps_np,
+            topps_np=topps_np, steps=steps,
+            page_tables_np=page_tables_np).wait()
 
     # ------------------------------------------------------------------
     def score_batch(self, sequences: list[list[int]], top_k: int = 0
@@ -1438,3 +1466,54 @@ class Engine:
                 return
             logits, stats = self.decode_one(token)
             token = int(sampler.sample(logits[0]))
+
+
+class SlotDispatch:
+    """Completion handle for one in-flight :meth:`Engine.slot_step_async`
+    dispatch.
+
+    ``tokens_dev`` is the (steps, B) sampled-id future; ``last_dev`` the
+    (B,) final sampled row, kept device-resident so the next pure-decode
+    dispatch can consume it via ``feed_dev`` without any host transfer.
+    ``fresh`` reports whether this dispatch minted a new XLA executable —
+    the scheduler uses it to keep trace+compile walls out of its
+    step-time EMA.  ``wait()`` is the blocking edge (idempotent): it runs
+    :meth:`Engine._sync` (fault point + step watchdog), feeds the compile
+    histogram on a fresh executable, stamps the engine's
+    ``last_slot_dispatch_ms``, and returns the tokens as one host array —
+    the single device→host transfer a dispatch pays.
+    """
+
+    __slots__ = ("_engine", "tokens_dev", "last_dev", "t", "steps",
+                 "fresh", "enqueued_at", "ready_at", "_out")
+
+    def __init__(self, engine, tokens_dev, last_dev, *, t: int, steps: int,
+                 fresh: bool, enqueued_at: float):
+        self._engine = engine
+        self.tokens_dev = tokens_dev
+        self.last_dev = last_dev
+        self.t = t
+        self.steps = steps
+        self.fresh = fresh
+        self.enqueued_at = enqueued_at  # perf_counter at enqueue
+        self.ready_at: float | None = None
+        self._out: np.ndarray | None = None
+
+    def wait(self) -> np.ndarray:
+        """Block until the dispatch lands; returns the (steps, B) ids."""
+        if self._out is not None:
+            return self._out
+        eng = self._engine
+        eng._sync(self.tokens_dev, "slot step")
+        t1 = time.perf_counter()
+        self.ready_at = t1
+        if self.fresh:  # first call blocked through trace + compile
+            obs_metrics.ENGINE_COMPILE_S.observe(t1 - self.enqueued_at)
+        # enqueue→ready span, read by the scheduler's slot timeline
+        # (obs/flight.py); for an overlapped dispatch it includes the
+        # predecessor still executing, so it bounds device time from above
+        eng.last_slot_dispatch_ms = (t1 - self.enqueued_at) * 1e3
+        obs_trace.record("slot_step", self.enqueued_at, t1,
+                         t=self.t, steps=self.steps)
+        self._out = np.asarray(self.tokens_dev)  # (steps, B)
+        return self._out

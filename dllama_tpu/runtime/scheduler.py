@@ -25,7 +25,18 @@ Mechanics per dispatch:
 * a freed slot is reused by handing its row position 0 again — the
   previous occupant's stale KV sits above the newcomer's causal ceiling
   (ops/attention.py ``slot_gqa_attention_at``), so per-slot reset is
-  free and the cache is never zeroed.
+  free and the cache is never zeroed;
+* with ``overlap`` (default on) steady-state decode runs as a two-deep
+  pipeline: while dispatch N's tokens land and fan out host-side,
+  dispatch N+1 is already enqueued on device, fed by N's on-device
+  last-token row (``Engine.slot_step_async``'s ``feed_dev`` — no
+  device→host→device round trip).  Every *flush point* — a queued
+  ticket awaiting admission, slot retire, cancel/deadline,
+  ``exclusive()`` parking, hand-off export/import, drain — falls back
+  to synchronous dispatch: the speculative dispatch is landed and
+  discarded, its KV writes sit above every surviving row's position
+  (masked by the causal ceiling exactly like slot reuse), and greedy
+  output stays byte-identical with overlap on or off.
 
 Each submitted request gets a :class:`Ticket` — a thread-safe token
 stream the HTTP handler consumes.  Cancellation (client disconnect, stop
@@ -56,6 +67,7 @@ import numpy as np
 from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics, trace as obs_trace
 from ..obs.log import get_logger, new_request_id, request_id_var
+from .faults import FAULTS
 from .pagepool import PagePool, PagePoolExhausted, RadixTree
 
 _log = get_logger("runtime.scheduler")
@@ -140,6 +152,24 @@ class _Slot:
         self.inserted = False        # prompt pages handed to the tree yet?
 
 
+class _Pending:
+    """One in-flight dispatch: the engine's completion handle plus the
+    host-side view frozen at enqueue time — who rode it, at what clocks,
+    with which sampling params.  The pipeline in
+    :meth:`SlotScheduler._dispatch` keeps at most one of these beyond
+    the dispatch it is currently landing (depth 2)."""
+
+    __slots__ = ("handle", "error", "active", "tickets", "steps",
+                 "t_width", "n_valid", "temps", "topps", "prefset",
+                 "rid_by_slot", "fed_by_slot", "pos_rows", "enq_tp",
+                 "t0_mono", "host_gap_ms", "idle_ms", "overlapped",
+                 "queued")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+
 class SlotScheduler:
     """Owns the batch engine; see the module docstring.  ``max_queue``
     bounds requests waiting for a slot (beyond it submit() raises
@@ -147,7 +177,8 @@ class SlotScheduler:
 
     def __init__(self, engine, *, prefill_chunk: int = 16,
                  max_wait_ms: float = 50.0, decode_burst: int = 16,
-                 max_queue: int = 32, prefix_reuse: bool = True):
+                 max_queue: int = 32, prefix_reuse: bool = True,
+                 overlap: bool = True):
         if engine.sp > 1:
             raise ValueError("slot scheduling is not supported on sp meshes")
         if engine.cache.quantized:
@@ -188,6 +219,17 @@ class SlotScheduler:
         self._idle = threading.Event()  # set while paused with empty slots
         self._paused = 0
         self._step_ms_ema: float | None = None
+        # overlapped-dispatch pipeline (see module docstring).  All
+        # fields are mutated on the scheduler thread only; _inflight_n
+        # is additionally read under _cond by _flushed() waiters, and
+        # _flush_req is written by them.
+        self.overlap = bool(overlap)
+        self._inflight_n = 0     # speculative dispatches on device
+        self._flush_req = 0      # >0: flush requested, speculation blocked
+        self._depth = 0          # dispatches enqueued but not yet landed
+        self._n_dispatched = 0
+        self._n_overlapped = 0
+        self._park_wakeups = 0   # parked-wait iterations (idle test hook)
         # goodput accounting: every ms between the first and the latest
         # dispatch lands in exactly one component (see obs/metrics.py)
         self._first_dispatch_at: float | None = None   # perf_counter
@@ -303,6 +345,35 @@ class SlotScheduler:
         with self._cond:
             self._cond.notify_all()
 
+    # -- pipeline flush ------------------------------------------------
+    @contextlib.contextmanager
+    def _flushed(self):
+        """Hold the dispatch pipeline empty: block new speculation, wait
+        for any in-flight speculative dispatch to land (it is discarded
+        at the flush point), then yield with ``self._cond`` held and
+        zero dispatches in flight.  The DLREQ01 exporter runs inside
+        this window so its snapshots never observe a half-landed
+        burst."""
+        with self._cond:
+            self._flush_req += 1
+            self._cond.notify_all()
+            try:
+                if not self._cond.wait_for(
+                        lambda: self._inflight_n == 0, timeout=60.0):
+                    _log.error("pipeline flush timed out", extra={
+                        "inflight": self._inflight_n})
+                yield
+            finally:
+                self._flush_req -= 1
+                self._cond.notify_all()
+
+    def flush(self) -> None:
+        """Synchronize the dispatch pipeline: returns only once zero
+        dispatches are in flight.  Speculation resumes immediately
+        after."""
+        with self._flushed():
+            pass
+
     # -- paged state snapshot/restore (runtime/snapshot.py DLSNAP02) ----
     def snapshot_paged(self, path, extra: dict | None = None) -> str:
         """Persist the paged serving state: the pool KV arrays ride the
@@ -393,7 +464,10 @@ class SlotScheduler:
         if self.pool is None:
             return {}
         records: dict[str, bytes] = {}
-        with self._cond:
+        # _flushed() lands-and-discards any in-flight speculative
+        # dispatch before yielding, so every snapshot below observes
+        # step-boundary state only (acceptance: zero in-flight here)
+        with self._flushed():
             for i in self._active():
                 t = self.slots[i].ticket
                 try:
@@ -753,13 +827,24 @@ class SlotScheduler:
                     if not active:
                         if self._paused:
                             self._idle.set()
-                        # parked: submissions/cancels/close notify; the
-                        # short timeout re-checks queued deadlines.  The
-                        # slept time is "idle" in the goodput decomposition
-                        # (the remainder of an inter-dispatch gap is
-                        # host_gap — true scheduling overhead)
+                        # parked: submissions/cancels/close notify_all
+                        # immediately, so the timeout only has to cover
+                        # the earliest *queued* deadline (a paused
+                        # scheduler holds its queue), capped at 0.5s —
+                        # the old fixed 0.1s poll burned ~10 wakeups/s
+                        # doing nothing.  The slept time is "idle" in
+                        # the goodput decomposition (the remainder of an
+                        # inter-dispatch gap is host_gap — true
+                        # scheduling overhead)
+                        timeout = 0.5
+                        dls = [t.deadline for t in self._queue
+                               if t.deadline is not None]
+                        if dls:
+                            timeout = min(timeout,
+                                          max(min(dls) - now, 0.0))
                         w0 = time.perf_counter()
-                        self._cond.wait(0.1)
+                        self._cond.wait(timeout)
+                        self._park_wakeups += 1
                         self._idle_accum += time.perf_counter() - w0
                         continue
                 self._dispatch(active, queued)
@@ -775,6 +860,32 @@ class SlotScheduler:
                 self._idle.set()
 
     def _dispatch(self, active: list[int], queued: int) -> None:
+        """Run one dispatch round — and, with ``overlap`` on, keep a
+        second dispatch enqueued on device while the first one's tokens
+        land and fan out (a two-deep pipeline).  INVARIANT: zero
+        dispatches are in flight when this returns, so admission,
+        ``exclusive()``, drain and hand-off export all still happen at a
+        plain step boundary."""
+        cur = self._enqueue_first(active, queued)
+        while True:
+            spec = None
+            if cur.error is None and self.overlap:
+                spec = self._maybe_speculate(cur)
+            ok = self._land_and_fanout(cur)
+            if not ok or spec is None:
+                if spec is not None:
+                    self._abandon(spec)
+                return
+            survivors = self._pipeline_verdict(spec)
+            if survivors is None:
+                self._abandon(spec)
+                return
+            cur = spec
+
+    def _enqueue_first(self, active: list[int], queued: int) -> _Pending:
+        """Build and enqueue the round's first (host-fed) dispatch.
+        Does not block on the device — the returned handle's tokens are
+        still in flight."""
         eng = self.engine
         b = eng.batch
         slots = self.slots
@@ -833,10 +944,11 @@ class SlotScheduler:
         prefset = set(prefilling)
         rid_by_slot = {i: slots[i].ticket.rid for i in active}
         fed_by_slot = {i: int(n_valid[i]) for i in prefilling}
+        tickets = {i: slots[i].ticket for i in active}
 
         # inter-dispatch gap: idle (slept waiting for work) vs host_gap
-        # (token fanout, admission, array prep — the overhead ROADMAP
-        # item 3's on-device burst would amortize)
+        # (token fanout, admission, array prep — the overhead the
+        # overlapped pipeline exists to hide)
         tp0 = time.perf_counter()
         host_gap_ms = idle_ms = 0.0
         if self._last_dispatch_end is None:
@@ -850,23 +962,177 @@ class SlotScheduler:
             obs_metrics.SCHED_HOST_GAP_MS.observe(host_gap_ms)
         self._idle_accum = 0.0
 
-        t0 = time.monotonic()
-        error = None
+        handle, error = None, None
         try:
             with self._engine_lock:
-                out = eng.slot_step(tokens, pos_rows, n_valid,
-                                    temps_np=temps, topps_np=topps,
-                                    steps=steps,
-                                    page_tables_np=self._page_tables
-                                    if self.paged else None)
+                handle = eng.slot_step_async(
+                    tokens, pos_rows, n_valid, temps_np=temps,
+                    topps_np=topps, steps=steps,
+                    page_tables_np=self._page_tables
+                    if self.paged else None)
         except Exception as e:
             error = e
+        if handle is not None:
+            self._depth += 1
+            obs_metrics.SCHED_INFLIGHT_DEPTH.set(self._depth)
+        return _Pending(handle=handle, error=error, active=list(active),
+                        tickets=tickets, steps=steps, t_width=t_width,
+                        n_valid=n_valid, temps=temps, topps=topps,
+                        prefset=prefset, rid_by_slot=rid_by_slot,
+                        fed_by_slot=fed_by_slot, pos_rows=pos_rows,
+                        enq_tp=tp0, t0_mono=time.monotonic(),
+                        host_gap_ms=host_gap_ms, idle_ms=idle_ms,
+                        overlapped=False, queued=queued)
+
+    def _maybe_speculate(self, cur: _Pending) -> _Pending | None:
+        """While ``cur`` is still in flight, enqueue the next pure-decode
+        burst fed by ``cur``'s on-device last-token row.  Returns None at
+        any pipeline flush point — queued admission pending, drain /
+        pause / flush request, cancel or expired deadline, a row still
+        mid-prefill after ``cur``, a hand-off import, no context room —
+        and the round then completes synchronously."""
+        eng = self.engine
+        slots = self.slots
+        b = eng.batch
+        with self._cond:
+            if (self._stop or self._draining or self._paused
+                    or self._flush_req or self._queue):
+                return None
+            now = time.monotonic()
+            pos2 = np.zeros((b,), np.int32)
+            budget = 0
+            for j in range(b):
+                s = slots[j]
+                t = s.ticket
+                if j not in cur.tickets:
+                    if t is not None:
+                        return None   # hand-off import mid-round
+                    continue
+                if t is None or t is not cur.tickets[j]:
+                    return None       # slot re-bound under us
+                if t._cancel is not None or (t.deadline is not None
+                                             and now >= t.deadline):
+                    return None
+                nv = int(cur.n_valid[j])
+                if s.fed < len(t.prompt) and s.fed + nv < len(t.prompt):
+                    return None       # still mid-prefill after cur
+                pos2[j] = s.pos + nv + (cur.steps - 1)
+                made = 1 if j in cur.prefset else cur.steps
+                budget = max(budget, t.max_new - (s.produced + made))
+            if budget < 1:
+                # every row hits its token budget during ``cur``: unlike
+                # the sync path (which only learns a row retired after
+                # the burst lands), the speculation knows its
+                # predecessor's yield up front, so the all-overrun burst
+                # is avoidable waste, not a shape-count trade
+                return None
+            room = min(int(eng.seq_len) - int(pos2[i])
+                       for i in cur.active)
+            if room < 1:
+                return None
+            # sized exactly like the sync burst (mid-burst retirement
+            # overrun stays cheaper than minting tail shapes), so the
+            # overlap on/off A/B compares dispatch pipelining alone
+            steps2 = max(1, min(self.decode_burst, room))
+            steps2 = 1 << (steps2.bit_length() - 1)
+            # the import path rewrites _page_tables under _cond; freeze
+            # a copy so the enqueue below (outside the lock) cannot
+            # observe a half-written row
+            ptab = self._page_tables.copy() if self.paged else None
+            # reserve the in-flight count before releasing the lock so a
+            # concurrent _flushed() waiter sees this dispatch coming
+            self._inflight_n += 1
+        handle, err = None, None
+        try:
+            with self._engine_lock:
+                handle = eng.slot_step_async(
+                    None, pos2, np.ones((b,), np.int32),
+                    temps_np=cur.temps, topps_np=cur.topps, steps=steps2,
+                    page_tables_np=ptab, feed_dev=cur.handle.last_dev)
+        except Exception as e:
+            err = e
+        if err is not None:
+            with self._cond:
+                self._inflight_n -= 1
+                self._cond.notify_all()
+            _log.error("speculative enqueue failed; round completes "
+                       "synchronously", extra={"error": repr(err)})
+            return None
+        self._depth += 1
+        obs_metrics.SCHED_INFLIGHT_DEPTH.set(self._depth)
+        return _Pending(handle=handle, error=None,
+                        active=list(cur.active), tickets=dict(cur.tickets),
+                        steps=steps2, t_width=1,
+                        n_valid=np.ones((b,), np.int32),
+                        temps=cur.temps, topps=cur.topps, prefset=set(),
+                        rid_by_slot=dict(cur.rid_by_slot), fed_by_slot={},
+                        pos_rows=pos2, enq_tp=time.perf_counter(),
+                        t0_mono=time.monotonic(), host_gap_ms=0.0,
+                        idle_ms=0.0, overlapped=True, queued=0)
+
+    def _land_and_fanout(self, cur: _Pending) -> bool:
+        """Block until ``cur``'s tokens land, charge the goodput clock,
+        and fan the tokens out to their tickets.  Returns False when the
+        dispatch errored (every active slot retires with the error and
+        the pipeline round ends)."""
+        eng = self.engine
+        b = eng.batch
+        tw = time.perf_counter()
+        error, out = cur.error, None
+        if error is None:
+            try:
+                out = cur.handle.wait()
+            except Exception as e:
+                error = e
         tp1 = time.perf_counter()
+        prev_end = self._last_dispatch_end
         self._last_dispatch_end = tp1
-        wall_ms = (tp1 - tp0) * 1e3
+        if cur.handle is not None:
+            self._depth -= 1
+            obs_metrics.SCHED_INFLIGHT_DEPTH.set(self._depth)
+        self._n_dispatched += 1
+        if cur.overlapped:
+            self._n_overlapped += 1
+            with self._cond:
+                self._inflight_n -= 1
+                self._cond.notify_all()
+        obs_metrics.SCHED_OVERLAP_RATIO.set(
+            self._n_overlapped / self._n_dispatched)
+
+        n_pref, n_act = len(cur.prefset), len(cur.active)
+        hidden_ms = 0.0
+        if cur.overlapped:
+            # this dispatch was enqueued while its predecessor was still
+            # in flight, so the span [previous land end, this land end]
+            # is the wall it owns.  The host-side share (predecessor
+            # fanout + bookkeeping before wait() was called) is *hidden*
+            # when the land actually had to wait — the device was still
+            # computing underneath it — and *exposed* when the land
+            # returned immediately (the host was the bottleneck after
+            # all).  Either way every ms lands in exactly one goodput
+            # component, preserving the telescoping-sum contract.
+            host_ms = max(tw - prev_end, 0.0) * 1e3
+            wait_ms = max(tp1 - tw, 0.0) * 1e3
+            if wait_ms >= 0.1:
+                hidden_ms = host_ms
+                exposed_ms = 0.0
+                wall_ms = host_ms + wait_ms
+            else:
+                exposed_ms = host_ms
+                wall_ms = wait_ms
+            if exposed_ms:
+                self._account("host_gap", exposed_ms)
+                obs_metrics.SCHED_HOST_GAP_MS.observe(exposed_ms)
+            if hidden_ms:
+                obs_metrics.SCHED_HOST_GAP_HIDDEN_MS.inc(hidden_ms)
+            ts0 = prev_end
+            gap_exposed, gap_idle = exposed_ms, 0.0
+        else:
+            wall_ms = (tp1 - cur.enq_tp) * 1e3
+            ts0 = cur.enq_tp
+            gap_exposed, gap_idle = cur.host_gap_ms, cur.idle_ms
         # split the dispatch wall by row occupancy: every row rode the
         # same lockstep step, so a row's share IS wall * rows/b
-        n_pref, n_act = len(prefilling), len(active)
         self._account("prefill", wall_ms * n_pref / b)
         self._account("decode", wall_ms * (n_act - n_pref) / b)
         self._account("pad", wall_ms * (b - n_act) / b)
@@ -876,56 +1142,152 @@ class SlotScheduler:
             obs_metrics.SCHED_GOODPUT_RATIO.set(busy / total)
 
         if error is not None:
-            # a failed dispatch poisons at most this step: retire every
+            # a failed dispatch poisons at most this round: retire every
             # active slot with the error and keep serving — stale cache
             # garbage sits above future occupants' causal ceilings
             _log.error("slot dispatch failed", extra={"error": repr(error)})
             obs_flight.TIMELINE.record_step(
-                ts=tp0, wall_ms=wall_ms, host_gap_ms=host_gap_ms,
-                idle_ms=idle_ms, steps=steps, t_width=t_width, error=True,
-                slots=self._slot_entries(active, prefset, rid_by_slot, {}))
+                ts=ts0, wall_ms=wall_ms, host_gap_ms=gap_exposed,
+                idle_ms=gap_idle, steps=cur.steps, t_width=cur.t_width,
+                error=True, overlapped=cur.overlapped,
+                hidden_host_ms=hidden_ms,
+                slots=self._slot_entries(cur.active, cur.prefset,
+                                         cur.rid_by_slot, {}))
             with self._cond:
                 for i in self._active():
                     self._retire(i, "error", error=error)
-            return
-        step_ms = wall_ms / steps
-        self._step_ms_ema = step_ms if self._step_ms_ema is None \
-            else 0.8 * self._step_ms_ema + 0.2 * step_ms
-        obs_trace.record("sched_step", t0, time.monotonic(),
-                         active=len(active), queued=queued,
-                         t=t_width, steps=steps,
-                         rids=sorted(rid_by_slot.values()))
+            return False
+        self._note_step_time(wall_ms, cur.steps, cur.handle.fresh)
+        obs_trace.record("sched_step", cur.t0_mono, time.monotonic(),
+                         active=n_act, queued=cur.queued,
+                         t=cur.t_width, steps=cur.steps,
+                         overlapped=cur.overlapped,
+                         rids=sorted(cur.rid_by_slot.values()))
 
-        emitted = dict.fromkeys(active, 0)
+        FAULTS.fire("sched.host_fanout")
+        emitted = dict.fromkeys(cur.active, 0)
         # the whole fanout holds _cond (re-entrant with the _retire calls
         # below): slot clocks (pos/fed/produced/last) and the ticket's
         # emitted list must never be observable half-advanced by the
         # hand-off exporter, which snapshots them from another thread
         with self._cond:
-            self._fanout(active, steps, out, n_valid, emitted)
+            self._fanout(cur.active, cur.steps, out, cur.n_valid, emitted)
 
         # flight phases + timeline entry for this dispatch (after the
         # fanout so the emitted-token counts are final; a row retired
         # mid-burst still gets its last burst recorded)
-        for i in active:
-            rid = rid_by_slot[i]
-            if i in prefset:
+        step_ms = wall_ms / cur.steps
+        for i in cur.active:
+            rid = cur.rid_by_slot[i]
+            if i in cur.prefset:
                 # a completing chunk also emits the first sampled token —
                 # recorded as ``emitted`` on the chunk, not a zero-wall
                 # synthetic burst
                 obs_flight.phase(rid, "prefill_chunk",
-                                 tokens=fed_by_slot[i], ms=wall_ms,
-                                 pos=int(pos_rows[i]), emitted=emitted[i])
+                                 tokens=cur.fed_by_slot[i], ms=wall_ms,
+                                 pos=int(cur.pos_rows[i]),
+                                 emitted=emitted[i])
             else:
-                obs_flight.phase(rid, "decode_burst", steps=steps,
+                obs_flight.phase(rid, "decode_burst", steps=cur.steps,
                                  tokens=emitted[i], wall_ms=wall_ms,
                                  step_ms=step_ms)
         obs_flight.TIMELINE.record_step(
-            ts=tp0, wall_ms=wall_ms,
+            ts=ts0, wall_ms=wall_ms,
             device_ms=getattr(eng, "last_slot_dispatch_ms", None),
-            host_gap_ms=host_gap_ms, idle_ms=idle_ms, steps=steps,
-            t_width=t_width,
-            slots=self._slot_entries(active, prefset, rid_by_slot, emitted))
+            host_gap_ms=gap_exposed, idle_ms=gap_idle, steps=cur.steps,
+            t_width=cur.t_width, overlapped=cur.overlapped,
+            hidden_host_ms=hidden_ms,
+            slots=self._slot_entries(cur.active, cur.prefset,
+                                     cur.rid_by_slot, emitted))
+        return True
+
+    def _pipeline_verdict(self, spec: _Pending) -> list[int] | None:
+        """After ``spec``'s predecessor landed and fanned out with
+        ``spec`` still in flight: decide whether ``spec``'s tokens may
+        be emitted.  Returns the surviving slot list, or None for a hard
+        flush (``spec`` must be discarded).  A slot that merely retired
+        in the predecessor's fanout (EOS / budget) survives row-wise
+        removal — the burst computed its row for nothing, which is
+        cheaper than flushing the whole pipeline."""
+        slots = self.slots
+        with self._cond:
+            if (self._stop or self._draining or self._paused
+                    or self._flush_req or self._queue):
+                return None
+            now = time.monotonic()
+            survivors = []
+            for j in range(len(slots)):
+                s = slots[j]
+                if j not in spec.tickets:
+                    if s.ticket is not None:
+                        return None   # import bound a slot mid-pipeline
+                    continue
+                t = s.ticket
+                if t is None:
+                    continue          # retired by the predecessor's fanout
+                if t is not spec.tickets[j]:
+                    return None       # slot re-bound (import into freed row)
+                if t._cancel is not None or (t.deadline is not None
+                                             and now >= t.deadline):
+                    return None       # honor the step boundary, like sync
+                survivors.append(j)
+            if not survivors:
+                return None
+            spec.active = survivors
+            spec.tickets = {j: spec.tickets[j] for j in survivors}
+            spec.rid_by_slot = {j: spec.rid_by_slot[j] for j in survivors}
+            return survivors
+
+    def _abandon(self, spec: _Pending) -> None:
+        """Land and discard an in-flight speculative dispatch at a flush
+        point.  No slot clock ever advanced for it and its tokens are
+        never emitted, so greedy output is byte-identical to never
+        having speculated: its KV writes all sit above every surviving
+        row's position — masked by the causal ceiling and rewritten
+        identically by the synchronous redo dispatch, exactly like slot
+        reuse.  The sampler RNG tick it consumed is not rewound: sampled
+        draws are co-scheduling-dependent by contract (module
+        docstring); greedy rows never touch the stream."""
+        try:
+            spec.handle.wait()
+        except Exception as e:
+            # the discarded dispatch owns its own failure — nothing was
+            # emitted from it; the next live dispatch re-probes the device
+            _log.error("discarded in-flight dispatch failed", extra={
+                "error": repr(e)})
+        tp1 = time.perf_counter()
+        prev_end = self._last_dispatch_end
+        self._last_dispatch_end = tp1
+        self._depth -= 1
+        obs_metrics.SCHED_INFLIGHT_DEPTH.set(self._depth)
+        self._n_dispatched += 1
+        self._n_overlapped += 1
+        obs_metrics.SCHED_OVERLAP_RATIO.set(
+            self._n_overlapped / self._n_dispatched)
+        with self._cond:
+            self._inflight_n -= 1
+            self._cond.notify_all()
+        wall_ms = max(tp1 - prev_end, 0.0) * 1e3
+        # burned device capacity, not goodput
+        self._account("pad", wall_ms)
+        obs_metrics.SCHED_OVERLAP_DISCARDS.inc()
+        obs_flight.TIMELINE.record_step(
+            ts=prev_end, wall_ms=wall_ms, steps=spec.steps, t_width=1,
+            overlapped=True, discarded=True,
+            slots=self._slot_entries([], set(), {}, {}))
+
+    def _note_step_time(self, wall_ms: float, steps: int,
+                        fresh: bool) -> None:
+        """Fold one dispatch's per-step wall into the EMA that clamps
+        burst size under queue pressure — except fresh-compile
+        dispatches, whose trace+compile seconds would poison the EMA and
+        pin bursts near 1 for dozens of dispatches after every new
+        compile key."""
+        if fresh:
+            return
+        step_ms = wall_ms / max(1, steps)
+        self._step_ms_ema = step_ms if self._step_ms_ema is None \
+            else 0.8 * self._step_ms_ema + 0.2 * step_ms
 
     def _fanout(self, active: list[int], steps: int, out, n_valid,
                 emitted: dict[int, int]) -> None:

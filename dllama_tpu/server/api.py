@@ -2486,13 +2486,15 @@ def main(argv=None):
                 batch_engine, prefill_chunk=args.sched_prefill_chunk,
                 max_wait_ms=args.sched_max_wait_ms,
                 max_queue=args.sched_max_queue,
-                prefix_reuse=not args.no_prefix_reuse)
+                prefix_reuse=not args.no_prefix_reuse,
+                overlap=not args.no_sched_overlap)
             _log.info("slot_scheduler_enabled", extra={
                 "slots": args.batch_slots,
                 "prefill_chunk": args.sched_prefill_chunk,
                 "max_wait_ms": args.sched_max_wait_ms,
                 "paged": scheduler.paged,
-                "prefix_reuse": scheduler.prefix_cache is not None})
+                "prefix_reuse": scheduler.prefix_cache is not None,
+                "overlap": scheduler.overlap})
         except ValueError as e:
             # quantized KV / sp mesh: lockstep batch serving still works,
             # only decode-step admission is off
