@@ -187,6 +187,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "bursts) and dispatch fully synchronously — debug "
                         "switch and A/B baseline; greedy output is "
                         "byte-identical either way (docs/PERF.md)")
+    p.add_argument("--no-preempt", action="store_true",
+                   help="QoS: disable priority preemption (paged scheduler "
+                        "only); admission stays priority-ordered but a "
+                        "higher-priority arrival never evicts a running "
+                        "lower-priority slot (docs/SERVING.md QoS)")
+    p.add_argument("--preempt-age-ms", type=float, default=5000.0,
+                   help="QoS: a queued request climbs one priority class "
+                        "per this many ms waited, bounding starvation of "
+                        "batch traffic behind interactive load (0 = no "
+                        "aging; aged rank affects admission order only, "
+                        "never eviction)")
+    p.add_argument("--preempt-cap", type=int, default=3,
+                   help="QoS: max times one request may be preempted and "
+                        "parked; past the cap it finishes honestly with "
+                        "finish_reason=\"preempted\" and whatever tokens "
+                        "it produced")
+    p.add_argument("--preempt-spill-dir", default=None,
+                   help="QoS: spill parked DLREQ01 records of preempted "
+                        "requests to this directory instead of holding "
+                        "them in RAM (the parked count stays bounded by "
+                        "--sched-max-queue either way)")
     # ---- serving robustness (api server; docs/ROBUSTNESS.md) ----
     p.add_argument("--host", default="0.0.0.0",
                    help="api server: bind address (default 0.0.0.0)")

@@ -206,7 +206,7 @@ class FlightRecorder:
             out.append({k: rec.get(k) for k in
                         ("request_id", "submitted_at", "slot", "n_prompt",
                          "produced", "queued_ms", "ttft_s", "duration_ms",
-                         "finish", "path")})
+                         "finish", "path", "priority", "preempt_count")})
         return out
 
     def __len__(self) -> int:

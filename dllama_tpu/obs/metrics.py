@@ -648,6 +648,25 @@ SCHED_OVERLAP_DISCARDS = REGISTRY.counter(
     "Speculative dispatches landed and thrown away at a pipeline flush "
     "point (admission, retire, cancel/deadline, drain, hand-off export).")
 
+# multi-tenant QoS (runtime/scheduler.py preemption + server shedding).
+# A higher-priority request that cannot admit evicts the lowest-priority
+# longest-remaining slot through the DLREQ01 export path and parks the
+# record; the server sheds low-priority admissions while the SLO error
+# budget burns.
+SCHED_PREEMPTIONS = REGISTRY.labeled_counter(
+    "sched_preemptions", ("reason",),
+    "Slot preemptions triggered by a higher-priority request, by trigger "
+    "(no_free_slot / pool_exhausted).")
+SCHED_PREEMPT_PARKED = REGISTRY.gauge(
+    "sched_preempt_parked",
+    "Preempted requests currently parked as DLREQ01 records awaiting "
+    "re-admission (RAM or --preempt-spill-dir).")
+ADMISSIONS_SHED = REGISTRY.labeled_counter(
+    "admissions_shed", ("class",),
+    "Admissions refused (429) by SLO-driven shedding, per priority class "
+    "(batch sheds on a fast-window burn, standard only while violating; "
+    "interactive is never shed).")
+
 # SLO burn-rate engine (obs/slo.py): burn = observed bad fraction over a
 # rolling window / allowed bad fraction; >= 1.0 means the error budget is
 # burning faster than the objective permits.

@@ -182,7 +182,7 @@ class Registry:
 
     # -- scoring -------------------------------------------------------
     @staticmethod
-    def _score(b: Backend) -> float:
+    def _score(b: Backend, interactive: bool = False) -> float:
         h = b.last_health or {}
         cap = h.get("capacity") or {}
         free_slots = cap.get("free_slots")
@@ -195,7 +195,12 @@ class Registry:
             score += min(float(free_pages), 1e5) * 1e-6
         if h.get("degraded"):
             score -= _PENALTY
-        if (h.get("slo") or {}).get("status") == "violating":
+        if (h.get("slo") or {}).get("status") == "violating" \
+                and not interactive:
+            # steer low-priority dispatch away from a replica that is
+            # burning its SLO budget, but keep it fully eligible for
+            # interactive traffic — the replica sheds batch/standard
+            # itself, so interactive capacity there is real
             score -= _PENALTY
         return score
 
@@ -212,14 +217,17 @@ class Registry:
             out.append(b)
         return out
 
-    def pick(self, exclude=()) -> Backend | None:
+    def pick(self, exclude=(), priority: str | None = None
+             ) -> Backend | None:
         """Least-loaded eligible backend, or None when the fleet has no
         capacity to offer (all ejected/draining/excluded)."""
+        interactive = priority == "interactive"
         with self._lock:
             cands = self._eligible_locked(set(exclude), handoff=False)
             if not cands:
                 return None
-            return max(cands, key=self._score)
+            return max(cands,
+                       key=lambda b: self._score(b, interactive))
 
     def handoff_peers(self, exclude=()) -> list[Backend]:
         """Eligible hand-off importers, best-scored first (the record is

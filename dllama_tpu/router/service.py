@@ -43,6 +43,7 @@ from urllib.parse import parse_qs
 
 from ..obs import flight as obs_flight, metrics as obs_metrics
 from ..obs.log import get_logger, set_request_id
+from ..server.backoff import jittered_retry_after
 from .registry import Backend, Registry
 
 _log = get_logger("router.service")
@@ -50,6 +51,7 @@ _log = get_logger("router.service")
 _RID_RE = re.compile(r"[^A-Za-z0-9._-]")
 _RID_MAX = 64
 MAX_BODY_BYTES = 1 << 20
+_PRIORITIES = ("interactive", "standard", "batch")
 
 
 def _iter_sse(resp):
@@ -257,7 +259,8 @@ def make_handler(state: RouterState):
             b = state.registry.pick()
             if b is None:
                 self._json(503, {"error": "no backend available"},
-                           headers=[("Retry-After", "5")])
+                           headers=[("Retry-After",
+                                     jittered_retry_after(5))])
                 return
             try:
                 conn = state.connect(b)
@@ -303,6 +306,13 @@ def make_handler(state: RouterState):
                 "", self.headers.get("X-Request-Id") or "")[:_RID_MAX] \
                 or uuid.uuid4().hex[:16]
             set_request_id(self._rid)
+            # QoS class rides alongside X-Request-Id: body field wins
+            # over the header; unknown values degrade to None (the
+            # replica applies its own default/validation)
+            prio = body.get("priority") \
+                or self.headers.get("X-Dllama-Priority")
+            prio = str(prio).strip().lower() if prio is not None else None
+            self._prio = prio if prio in _PRIORITIES else None
             self._proxy_completion(path, raw, body)
 
         def _proxy_completion(self, path: str, raw: bytes,
@@ -310,12 +320,14 @@ def make_handler(state: RouterState):
             chat = path == "/v1/chat/completions"
             stream = bool(body.get("stream"))
             rid = self._rid
-            obs_flight.submit(rid, path=path, stream=stream, hop=state.hop)
+            obs_flight.submit(rid, path=path, stream=stream, hop=state.hop,
+                              priority=self._prio)
             ctx = _Ctx()
             tried: list[Backend] = []
             retries_left = state.retries
             while True:
-                b = state.registry.pick(exclude=tried)
+                b = state.registry.pick(exclude=tried,
+                                        priority=self._prio)
                 if b is None:
                     self._out_of_backends(ctx, chat, rid)
                     return
@@ -358,7 +370,7 @@ def make_handler(state: RouterState):
                 obs_flight.retire(rid, reason=f"busy_{status}")
                 return
             self._json(503, {"error": "no backend available"},
-                       headers=[("Retry-After", "5")])
+                       headers=[("Retry-After", jittered_retry_after(5))])
             obs_flight.retire(rid, reason="no_backend")
 
         def _finish_replica_lost(self, ctx: _Ctx, chat: bool,
@@ -389,10 +401,12 @@ def make_handler(state: RouterState):
                 return "retry"
             try:
                 try:
-                    conn.request("POST", path, raw, headers={
-                        "Content-Type": "application/json",
-                        "X-Request-Id": rid,
-                        "X-Dllama-Hop": state.hop})
+                    headers = {"Content-Type": "application/json",
+                               "X-Request-Id": rid,
+                               "X-Dllama-Hop": state.hop}
+                    if getattr(self, "_prio", None):
+                        headers["X-Dllama-Priority"] = self._prio
+                    conn.request("POST", path, raw, headers=headers)
                     resp = conn.getresponse()
                 except OSError:
                     state.registry.record_failure(b)

@@ -74,6 +74,13 @@ _log = get_logger("runtime.scheduler")
 
 _DONE = object()  # ticket stream terminator
 
+# multi-tenant QoS classes (lower level = more important).  The wire
+# names ride the OpenAI surface (body ``priority`` / X-Dllama-Priority);
+# the scheduler orders admission by level and preempts strictly
+# lower-priority slots for a higher-priority arrival.
+PRIORITY_LEVELS = {"interactive": 0, "standard": 1, "batch": 2}
+PRIORITY_NAMES = {v: k for k, v in PRIORITY_LEVELS.items()}
+
 
 class SchedulerClosed(RuntimeError):
     """submit() after begin_drain()/close(): no new work is admitted."""
@@ -90,17 +97,24 @@ class Ticket:
     HTTP handler thread; ``cancel`` may be called from either side."""
 
     def __init__(self, prompt, max_new, temperature, top_p, eos_ids,
-                 deadline):
+                 deadline, priority: int = 1):
         self.prompt = list(prompt)
         self.max_new = int(max_new)
         self.temperature = float(temperature)
         self.top_p = float(top_p)
         self.eos_ids = tuple(eos_ids)
         self.deadline = deadline  # time.monotonic() or None
-        self.finish: str | None = None  # stop/length/timeout/aborted/error/handoff
+        # finish: stop/length/timeout/aborted/error/handoff/preempted
+        self.finish: str | None = None
         self.error: BaseException | None = None
         self.slot: int | None = None
         self.submitted_at = time.monotonic()
+        # QoS: priority level (PRIORITY_LEVELS), how many times this
+        # request has been evicted to the parked area, and the total time
+        # it spent parked (ms) — all three ride DLREQ01 hand-offs
+        self.priority = int(priority)
+        self.preempt_count = 0
+        self.parked_ms = 0.0
         # hand-off state (runtime/snapshot.py DLREQ01): the server parks
         # its stop strings here so a drain-time export can ship them, and
         # every emitted completion token is kept so the importing replica
@@ -152,6 +166,21 @@ class _Slot:
         self.inserted = False        # prompt pages handed to the tree yet?
 
 
+class _Parked:
+    """One preempted request: its live Ticket (the consumer is still
+    blocked on the stream — parking is invisible beyond a stall) plus the
+    DLREQ01 record that resumes it, held in RAM or spilled to
+    ``--preempt-spill-dir``."""
+
+    __slots__ = ("ticket", "blob", "path", "parked_at")
+
+    def __init__(self, ticket, blob, path, parked_at):
+        self.ticket = ticket
+        self.blob = blob          # bytes, or None when spilled to disk
+        self.path = path          # spill file, or None when in RAM
+        self.parked_at = parked_at
+
+
 class _Pending:
     """One in-flight dispatch: the engine's completion handle plus the
     host-side view frozen at enqueue time — who rode it, at what clocks,
@@ -178,7 +207,10 @@ class SlotScheduler:
     def __init__(self, engine, *, prefill_chunk: int = 16,
                  max_wait_ms: float = 50.0, decode_burst: int = 16,
                  max_queue: int = 32, prefix_reuse: bool = True,
-                 overlap: bool = True):
+                 overlap: bool = True, preempt: bool = True,
+                 preempt_age_ms: float = 5000.0, preempt_cap: int = 3,
+                 parked_max: int | None = None,
+                 spill_dir: str | None = None):
         if engine.sp > 1:
             raise ValueError("slot scheduling is not supported on sp meshes")
         if engine.cache.quantized:
@@ -207,6 +239,19 @@ class SlotScheduler:
             obs_metrics.KV_PAGES_TOTAL.set(self.pool.capacity)
             obs_metrics.KV_PAGES_IN_USE.set(0)
         self._queue: deque[Ticket] = deque()
+        # QoS preemption (paged mode only — the DLREQ01 export path is
+        # the eviction mechanism).  Aging bounds starvation: a queued
+        # ticket's effective level drops one class per preempt_age_ms
+        # waited.  preempt_cap bounds per-request churn; parked_max
+        # bounds the spill area — beyond either, the victim retires with
+        # honest finish "preempted" instead of parking.
+        self.preempt = bool(preempt)
+        self.preempt_age_ms = float(preempt_age_ms)
+        self.preempt_cap = max(0, int(preempt_cap))
+        self.parked_max = self.max_queue if parked_max is None \
+            else max(0, int(parked_max))
+        self.spill_dir = spill_dir
+        self._parked: list[_Parked] = []
         self._cond = threading.Condition()
         # serializes engine cache access between the dispatch loop (whose
         # jit step donates the cache buffer) and the hand-off export/
@@ -245,11 +290,14 @@ class SlotScheduler:
     def submit(self, prompt: list[int], max_new: int, *,
                temperature: float = 0.0, top_p: float = 0.9,
                eos_ids: tuple[int, ...] = (),
-               deadline: float | None = None) -> Ticket:
+               deadline: float | None = None,
+               priority: int = 1) -> Ticket:
         """Queue one request; returns its :class:`Ticket` immediately.
         ``deadline`` is a ``time.monotonic()`` instant (the server's
         per-request deadline); an expired request retires with finish
-        ``timeout`` and whatever tokens it produced."""
+        ``timeout`` and whatever tokens it produced.  ``priority`` is a
+        :data:`PRIORITY_LEVELS` level: admission is priority-ordered and
+        a higher-priority arrival may preempt lower-priority slots."""
         if not prompt:
             raise ValueError("empty prompt")
         if max_new < 1:
@@ -265,7 +313,8 @@ class SlotScheduler:
                     f"request needs {n_pages} KV pages but the pool has "
                     f"{self.pool.capacity}; raise --kv-pages or shorten "
                     "the request")
-        t = Ticket(prompt, max_new, temperature, top_p, eos_ids, deadline)
+        t = Ticket(prompt, max_new, temperature, top_p, eos_ids, deadline,
+                   priority=max(0, min(max(PRIORITY_NAMES), int(priority))))
         with self._cond:
             if self._stop or self._draining:
                 raise SchedulerClosed("scheduler is draining")
@@ -282,7 +331,8 @@ class SlotScheduler:
             self._queue.append(t)
             self._cond.notify_all()
         obs_flight.submit(t.rid, n_prompt=len(t.prompt), max_new=t.max_new,
-                          temperature=t.temperature, source="scheduler")
+                          temperature=t.temperature, source="scheduler",
+                          priority=PRIORITY_NAMES.get(t.priority, "standard"))
         return t
 
     def occupancy(self) -> dict:
@@ -290,7 +340,8 @@ class SlotScheduler:
         with self._cond:
             active = sum(1 for s in self.slots if s.ticket is not None)
             out = {"slots": len(self.slots), "active": active,
-                   "queued": len(self._queue)}
+                   "queued": len(self._queue),
+                   "parked": len(self._parked)}
             if self.pool is not None:
                 out["kv_pages_total"] = self.pool.capacity
                 out["kv_pages_free"] = self.pool.available
@@ -312,6 +363,10 @@ class SlotScheduler:
                     t = s.ticket
                     t.deadline = min(t.deadline, deadline) \
                         if (t.deadline and deadline) else (t.deadline or deadline)
+            for e in self._parked:
+                t = e.ticket
+                t.deadline = min(t.deadline, deadline) \
+                    if (t.deadline and deadline) else (t.deadline or deadline)
             self._cond.notify_all()
 
     def close(self, timeout: float = 5.0) -> None:
@@ -453,6 +508,8 @@ class SlotScheduler:
                 "eos_ids": list(t.eos_ids), "stop": list(t.stop),
                 "deadline_left": deadline_left,
                 "fed": s.fed, "produced": s.produced, "last": s.last,
+                "priority": t.priority, "preempt_count": t.preempt_count,
+                "parked_ms": t.parked_ms,
             })
 
     def handoff_export_all(self) -> dict[str, bytes]:
@@ -479,6 +536,21 @@ class SlotScheduler:
                     _log.error("handoff export failed", extra={
                         "rid": t.rid, "error": repr(e)})
                 self._retire(i, "handoff")
+            # parked (preempted) requests already ARE their own DLREQ01
+            # records — ship them as-is so a peer resumes them too
+            for e in list(self._parked):
+                t = e.ticket
+                try:
+                    blob = e.blob
+                    if blob is None:
+                        with open(e.path, "rb") as f:
+                            blob = f.read()
+                    records[t.rid] = blob
+                except Exception as exc:
+                    _log.error("handoff export of parked record failed",
+                               extra={"rid": t.rid, "error": repr(exc)})
+                self._drop_parked_locked(e)
+                self._fail_ticket(t, "handoff")
             while self._queue:
                 self._fail_ticket(self._queue.popleft(), "handoff")
             self._cond.notify_all()
@@ -588,6 +660,9 @@ class SlotScheduler:
             t.rid = str(extra.get("rid") or t.rid)
             t.stop = [str(x) for x in extra.get("stop") or []]
             t.emitted = list(completion)
+            t.priority = int(extra.get("priority", 1))
+            t.preempt_count = int(extra.get("preempt_count", 0))
+            t.parked_ms = float(extra.get("parked_ms", 0.0))
             t._on_cancel = self._wake
             s = self.slots[slot_idx]
             s.ticket = t
@@ -609,7 +684,8 @@ class SlotScheduler:
             obs_metrics.SCHED_SLOT_JOINS.inc(slot_idx)
             self._cond.notify_all()
         obs_flight.submit(t.rid, n_prompt=len(prompt), max_new=max_new,
-                          temperature=t.temperature, source="handoff")
+                          temperature=t.temperature, source="handoff",
+                          priority=PRIORITY_NAMES.get(t.priority, "standard"))
         obs_flight.admit(t.rid, slot=slot_idx, queued_ms=0.0,
                          prefix_reused=0)
         ctx = request_id_var.set(t.rid)
@@ -651,7 +727,10 @@ class SlotScheduler:
         finally:
             request_id_var.reset(ctx)
         obs_flight.retire(t.rid, reason, produced=s.produced, pos=s.pos,
-                          error=repr(error) if error is not None else None)
+                          error=repr(error) if error is not None else None,
+                          preempt_count=t.preempt_count or None,
+                          parked_ms=round(t.parked_ms, 3)
+                          if t.parked_ms else None)
         t._q.put(_DONE)
 
     def _fail_ticket(self, t: Ticket, reason: str,
@@ -727,24 +806,68 @@ class SlotScheduler:
         obs_metrics.KV_PAGES_IN_USE.set(pool.in_use)
         return True
 
+    def _eff_level(self, t: Ticket, now: float) -> int:
+        """Effective priority level after aging: a waiting ticket climbs
+        one class per ``preempt_age_ms`` waited, bounding starvation of
+        batch traffic behind a steady interactive stream.  ``<= 0``
+        disables aging."""
+        lvl = t.priority
+        if self.preempt_age_ms > 0:
+            lvl -= int((now - t.submitted_at) * 1e3 / self.preempt_age_ms)
+        return lvl
+
     def _admit_locked(self, now: float) -> None:
-        """Move queued tickets into free slots (caller holds the lock)."""
-        for i, s in enumerate(self.slots):
-            if s.ticket is not None or not self._queue:
+        """Move waiting work into free slots in priority order (caller
+        holds the lock).  Candidates come from two places — the submit
+        queue and the parked (preempted) area; the best effective level
+        wins, parked beating queued on ties (they were admitted once
+        already).  A candidate that cannot get a slot or pages may
+        preempt a strictly lower-priority victim; otherwise admission
+        stops for the round (head-of-line keeps its place)."""
+        while True:
+            best = None  # (sort key, kind, ticket, parked entry)
+            for t in self._queue:
+                k = (self._eff_level(t, now), 1, t.submitted_at)
+                if best is None or k < best[0]:
+                    best = (k, "queued", t, None)
+            for e in self._parked:
+                k = (self._eff_level(e.ticket, now), 0,
+                     e.ticket.submitted_at)
+                if best is None or k < best[0]:
+                    best = (k, "parked", e.ticket, e)
+            if best is None:
+                return
+            _, kind, t, entry = best
+            if t._cancel is not None or (t.deadline is not None
+                                         and now >= t.deadline):
+                if kind == "queued":
+                    self._queue.remove(t)
+                else:
+                    self._drop_parked_locked(entry)
+                self._fail_ticket(t, t._cancel or "timeout")
                 continue
-            t = self._queue.popleft()
-            if t._cancel is not None:
-                self._fail_ticket(t, t._cancel)
-                continue
-            if t.deadline is not None and now >= t.deadline:
-                self._fail_ticket(t, "timeout")
-                continue
-            if self.pool is not None and not self._bind_pages(i, t):
-                # pool exhausted: the ticket keeps its place at the head
-                # of the queue and admission stops for this round —
+            free = next((i for i, s in enumerate(self.slots)
+                         if s.ticket is None), None)
+            if free is None:
+                if self._preempt_for_locked(t, now, "no_free_slot"):
+                    continue
+                return
+            if kind == "parked":
+                if self._unpark_locked(free, entry, now):
+                    continue
+                if self._preempt_for_locked(t, now, "pool_exhausted"):
+                    continue
+                return
+            if self.pool is not None and not self._bind_pages(free, t):
+                # pool exhausted: evict a lower-priority slot if one
+                # exists, else the ticket keeps its place at the head of
+                # the order and admission stops for this round —
                 # retirements free pages and the next pass retries
-                self._queue.appendleft(t)
-                break
+                if self._preempt_for_locked(t, now, "pool_exhausted"):
+                    continue
+                return
+            self._queue.remove(t)
+            s = self.slots[free]
             s.ticket = t
             # paged with a prefix hit: the matched tokens are already in
             # the cache (shared pages), so the clock starts past them and
@@ -753,24 +876,215 @@ class SlotScheduler:
             s.pos = s.fed = s.prefix_tokens
             s.produced = 0
             s.last = 0
-            t.slot = i
+            t.slot = free
             queued_ms = round((now - t.submitted_at) * 1e3, 3)
-            obs_metrics.SCHED_SLOT_JOINS.inc(i)
+            obs_metrics.SCHED_SLOT_JOINS.inc(free)
             obs_trace.record("sched_admit", t.submitted_at, now, rid=t.rid,
-                             slot=i, queued_ms=queued_ms,
+                             slot=free, queued_ms=queued_ms,
                              n_prompt=len(t.prompt),
-                             prefix_reused=s.prefix_tokens)
+                             prefix_reused=s.prefix_tokens,
+                             priority=PRIORITY_NAMES.get(t.priority,
+                                                         t.priority))
             ctx = request_id_var.set(t.rid)
             try:
                 _log.info("slot join", extra={
-                    "slot": i, "n_prompt": len(t.prompt),
+                    "slot": free, "n_prompt": len(t.prompt),
                     "queued_ms": queued_ms,
-                    "prefix_reused": s.prefix_tokens})
+                    "prefix_reused": s.prefix_tokens,
+                    "priority": PRIORITY_NAMES.get(t.priority, t.priority)})
             finally:
                 request_id_var.reset(ctx)
-            obs_flight.admit(t.rid, slot=i, queued_ms=queued_ms,
+            obs_flight.admit(t.rid, slot=free, queued_ms=queued_ms,
                              prefix_reused=s.prefix_tokens)
             obs_metrics.QUEUE_WAIT.observe(max(now - t.submitted_at, 0.0))
+
+    # -- QoS preemption (export → park → re-admit) ---------------------
+    def _preempt_for_locked(self, t: Ticket, now: float,
+                            reason: str) -> bool:
+        """Evict the lowest-priority longest-remaining slot so ``t`` can
+        admit.  Raw (un-aged) priorities gate eviction — an aged batch
+        ticket outranks newer batch arrivals for admission but never
+        evicts standard work.  Admission runs only between dispatch
+        rounds (``_dispatch``'s zero-in-flight invariant), so the export
+        below observes step-boundary state only; ``_inflight_n`` is
+        checked anyway as a belt-and-braces guard.  Returns False when
+        preemption is off, the scheduler is unpaged, or no strictly
+        lower-priority victim exists."""
+        if not self.preempt or self.pool is None or self._inflight_n:
+            return False
+        victims = [i for i, s in enumerate(self.slots)
+                   if s.ticket is not None and s.ticket.priority > t.priority]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda i: (
+            self.slots[i].ticket.priority,
+            self.slots[i].ticket.max_new - self.slots[i].produced))
+        self._preempt_locked(victim, reason, now)
+        return True
+
+    def _preempt_locked(self, slot_idx: int, reason: str,
+                        now: float) -> None:
+        """Evict one slot through the DLREQ01 export path: snapshot it,
+        park the record (RAM, or ``spill_dir``), free its pages, and
+        leave the ticket live — the streaming consumer sees only a
+        stall.  Over the per-request cap or with the parked area full,
+        the victim retires instead with honest finish ``preempted`` and
+        whatever tokens it produced."""
+        s = self.slots[slot_idx]
+        t = s.ticket
+        obs_metrics.SCHED_PREEMPTIONS.inc(reason)
+        obs_trace.record("sched_preempt", now, time.monotonic(), rid=t.rid,
+                         slot=slot_idx, reason=reason, produced=s.produced,
+                         priority=PRIORITY_NAMES.get(t.priority, t.priority))
+        if t.preempt_count >= self.preempt_cap \
+                or len(self._parked) >= self.parked_max:
+            self._retire(slot_idx, "preempted")
+            return
+        try:
+            blob = self._export_slot_locked(slot_idx)
+        except Exception as e:
+            # an unexportable slot cannot be parked — honest truncation
+            _log.error("preempt export failed", extra={
+                "rid": t.rid, "error": repr(e)})
+            self._retire(slot_idx, "preempted")
+            return
+        path = None
+        if self.spill_dir is not None:
+            import os
+            try:
+                os.makedirs(self.spill_dir, exist_ok=True)
+                path = os.path.join(self.spill_dir, f"{t.rid}.dlreq")
+                with open(path, "wb") as f:
+                    f.write(blob)
+                blob = None
+            except OSError as e:
+                path = None  # spill failed: keep the record in RAM
+                _log.error("preempt spill failed; keeping record in RAM",
+                           extra={"rid": t.rid, "error": repr(e)})
+        t.preempt_count += 1
+        self._parked.append(_Parked(t, blob, path, now))
+        s.ticket = None
+        t.slot = None
+        if s.pages:
+            self.pool.decref(s.pages)
+            s.pages = []
+            self._page_tables[slot_idx][:] = 0
+            obs_metrics.KV_PAGES_IN_USE.set(self.pool.in_use)
+        obs_metrics.SCHED_PREEMPT_PARKED.set(len(self._parked))
+        ctx = request_id_var.set(t.rid)
+        try:
+            _log.info("slot preempt", extra={
+                "slot": slot_idx, "reason": reason, "produced": s.produced,
+                "preempt_count": t.preempt_count,
+                "spilled": path is not None})
+        finally:
+            request_id_var.reset(ctx)
+        obs_flight.phase(t.rid, "preempted", slot=slot_idx, reason=reason,
+                         produced=s.produced,
+                         preempt_count=t.preempt_count)
+
+    def _unpark_locked(self, slot_idx: int, entry: _Parked,
+                       now: float) -> bool:
+        """Re-admit a parked request into ``slot_idx``, re-binding its
+        ORIGINAL ticket — the consumer is still blocked on the stream,
+        so resumption is invisible beyond the stall.  Continued greedy
+        decode is byte-identical to never having been preempted
+        (tests/test_qos.py pins this against a solo oracle).  Returns
+        True when the entry was consumed (resumed, or failed on an
+        unreadable record), False when pages are unavailable and it must
+        stay parked."""
+        from . import snapshot as snapfmt
+
+        eng = self.engine
+        t = entry.ticket
+        try:
+            blob = entry.blob
+            if blob is None:
+                with open(entry.path, "rb") as f:
+                    blob = f.read()
+            meta, arrays = snapfmt.loads_request(blob)
+        except Exception as e:
+            _log.error("parked record unreadable; request cannot resume",
+                       extra={"rid": t.rid, "error": repr(e)})
+            self._drop_parked_locked(entry)
+            self._fail_ticket(t, "preempted")
+            return True
+        ps = self.pool.page_size
+        pos = int(meta["pos"])
+        n_data = -(-pos // ps)
+        need = min(len(t.prompt) + t.max_new, eng.seq_len)
+        n_total = -(-need // ps)
+        try:
+            pages = self.pool.alloc(n_total)
+        except PagePoolExhausted:
+            pages = None
+            if self.prefix_cache is not None:
+                self.prefix_cache.evict(n_total - self.pool.available)
+                try:
+                    pages = self.pool.alloc(n_total)
+                except PagePoolExhausted:
+                    pass
+        if pages is None:
+            return False
+        extra = dict(meta.get("extra", {}))
+        others = any(s.ticket is not None for s in self.slots)
+        with self._engine_lock:
+            if n_data:
+                eng.write_pool_pages(pages[:n_data],
+                                     {"pages.k": arrays["pages.k"],
+                                      "pages.v": arrays["pages.v"]})
+            if not others and not self._queue and "rng_key" in arrays:
+                eng.set_rng(arrays["rng_key"], int(meta["chunk_counter"]))
+        s = self.slots[slot_idx]
+        s.ticket = t
+        s.pages = pages
+        s.prefix_tokens = 0
+        s.inserted = int(extra.get("fed", 0)) >= len(t.prompt)
+        s.pos = pos
+        s.fed = int(extra.get("fed", 0))
+        s.produced = int(extra.get("produced", len(t.emitted)))
+        s.last = int(extra.get("last", 0))
+        t.slot = slot_idx
+        row = self._page_tables[slot_idx]
+        row[:] = 0
+        row[:len(pages)] = pages
+        parked_ms = round((now - entry.parked_at) * 1e3, 3)
+        t.parked_ms += parked_ms
+        self._drop_parked_locked(entry)
+        obs_metrics.KV_PAGES_IN_USE.set(self.pool.in_use)
+        obs_metrics.SCHED_SLOT_JOINS.inc(slot_idx)
+        obs_trace.record("sched_resume", entry.parked_at, now, rid=t.rid,
+                         slot=slot_idx, parked_ms=parked_ms, pos=pos,
+                         priority=PRIORITY_NAMES.get(t.priority, t.priority))
+        ctx = request_id_var.set(t.rid)
+        try:
+            _log.info("slot resume", extra={
+                "slot": slot_idx, "pos": pos, "produced": s.produced,
+                "parked_ms": parked_ms})
+        finally:
+            request_id_var.reset(ctx)
+        obs_flight.phase(t.rid, "resumed", slot=slot_idx,
+                         parked_ms=parked_ms, pos=pos)
+        return True
+
+    def _drop_parked_locked(self, entry: _Parked) -> None:
+        with contextlib.suppress(ValueError):
+            self._parked.remove(entry)
+        if entry.path is not None:
+            import os
+            with contextlib.suppress(OSError):
+                os.remove(entry.path)
+        obs_metrics.SCHED_PREEMPT_PARKED.set(len(self._parked))
+
+    def _sweep_parked_locked(self, now: float) -> None:
+        for e in list(self._parked):
+            t = e.ticket
+            if t._cancel is not None:
+                self._drop_parked_locked(e)
+                self._fail_ticket(t, t._cancel)
+            elif t.deadline is not None and now >= t.deadline:
+                self._drop_parked_locked(e)
+                self._fail_ticket(t, "timeout")
 
     def _active(self) -> list[int]:
         return [i for i, s in enumerate(self.slots) if s.ticket is not None]
@@ -816,6 +1130,7 @@ class SlotScheduler:
                               or (q.deadline is not None and now >= q.deadline)]:
                         self._queue.remove(t)
                         self._fail_ticket(t, t._cancel or "timeout")
+                    self._sweep_parked_locked(now)
                     if not self._paused:
                         self._admit_locked(now)
                     active = self._active()
@@ -839,6 +1154,8 @@ class SlotScheduler:
                         timeout = 0.5
                         dls = [t.deadline for t in self._queue
                                if t.deadline is not None]
+                        dls += [e.ticket.deadline for e in self._parked
+                                if e.ticket.deadline is not None]
                         if dls:
                             timeout = min(timeout,
                                           max(min(dls) - now, 0.0))
@@ -857,6 +1174,9 @@ class SlotScheduler:
                     self._retire(i, "aborted")
                 while self._queue:
                     self._fail_ticket(self._queue.popleft(), "aborted")
+                for e in list(self._parked):
+                    self._drop_parked_locked(e)
+                    self._fail_ticket(e.ticket, "aborted")
                 self._idle.set()
 
     def _dispatch(self, active: list[int], queued: int) -> None:
@@ -996,7 +1316,7 @@ class SlotScheduler:
         b = eng.batch
         with self._cond:
             if (self._stop or self._draining or self._paused
-                    or self._flush_req or self._queue):
+                    or self._flush_req or self._queue or self._parked):
                 return None
             now = time.monotonic()
             pos2 = np.zeros((b,), np.int32)
@@ -1212,7 +1532,7 @@ class SlotScheduler:
         slots = self.slots
         with self._cond:
             if (self._stop or self._draining or self._paused
-                    or self._flush_req or self._queue):
+                    or self._flush_req or self._queue or self._parked):
                 return None
             now = time.monotonic()
             survivors = []

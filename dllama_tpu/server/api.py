@@ -77,10 +77,12 @@ from ..obs.log import (configure as configure_logging, get_logger,
                        new_request_id, set_request_id)
 from ..runtime.engine import ContextOverflow, Engine, NumericFault, StepTimeout
 from ..runtime.faults import FAULTS
-from ..runtime.scheduler import (SchedulerClosed, SchedulerSaturated,
+from ..runtime.scheduler import (PRIORITY_LEVELS, PRIORITY_NAMES,
+                                 SchedulerClosed, SchedulerSaturated,
                                  SlotScheduler)
 from ..runtime.snapshot import SnapshotMismatch
 from ..runtime.stream import drain_generation
+from .backoff import jittered_retry_after
 from ..tokenizer.bpe import Tokenizer
 from ..tokenizer.chat import ChatItem, ChatTemplate, TokenizerChatStops
 from ..tokenizer.eos import EosDetector
@@ -91,6 +93,15 @@ _log = get_logger("server.api")
 #: (it lands in logs and response headers verbatim otherwise)
 _RID_RE = re.compile(r"[^A-Za-z0-9._-]")
 _RID_MAX = 64
+
+
+def priority_level(value) -> int | None:
+    """QoS class name → scheduler level, or None for anything that is
+    not a known class (callers decide between 400 and silent default)."""
+    try:
+        return PRIORITY_LEVELS[str(value).strip().lower()]
+    except (KeyError, AttributeError):
+        return None
 
 #: request bodies above this are refused with 413 (an unbounded
 #: Content-Length read is an easy memory DoS against a model server)
@@ -533,6 +544,29 @@ class ApiState:
             depth = self._pending
         avg = self.metrics.avg_request_s or 1.0
         return max(1, min(int(depth * avg + 0.999), 60))
+
+    def should_shed(self, level: int) -> bool:
+        """SLO-driven shedding order (docs/SERVING.md QoS): interactive
+        is never shed; ``batch`` sheds as soon as ANY objective's burn
+        rate on the fast window reaches 1.0 (the error budget has
+        started burning — drop best-effort load before the verdict
+        degrades); ``standard`` sheds only once the overall verdict is
+        ``violating`` (every window burning — the replica is actually
+        failing its objectives, not just wobbling)."""
+        if self.slo is None or level <= PRIORITY_LEVELS["interactive"]:
+            return False
+        try:
+            verdict = self.slo.evaluate()
+        except Exception:
+            return False
+        if level >= PRIORITY_LEVELS["batch"]:
+            windows = verdict.get("windows") or []
+            if not windows:
+                return False
+            fast = windows[0]
+            return any((o.get("burn") or {}).get(fast, 0.0) >= 1.0
+                       for o in (verdict.get("objectives") or {}).values())
+        return verdict.get("status") == "violating"
 
     # -- deadlines ------------------------------------------------------
     def request_deadline(self, body: dict) -> float | None:
@@ -1129,7 +1163,8 @@ class ApiState:
     # -- continuous batching (runtime/scheduler.py) --------------------
     def sched_submit(self, prompt_tokens: list[int], max_tokens: int, *,
                      temperature: float, top_p: float, eos_id: int,
-                     deadline: float | None, stop: list[str] | None = None):
+                     deadline: float | None, stop: list[str] | None = None,
+                     priority: int = 1):
         """Validate and submit one request to the slot scheduler.  Split
         from :meth:`sched_drain` so streaming handlers can 400/429/503
         BEFORE committing to SSE headers.  Raises ContextOverflow /
@@ -1148,7 +1183,8 @@ class ApiState:
             max_new = min(max_new, max_tokens)
         ticket = self.scheduler.submit(
             prompt_tokens, max_new, temperature=temperature, top_p=top_p,
-            eos_ids=(eos_id,), deadline=self.effective_deadline(deadline))
+            eos_ids=(eos_id,), deadline=self.effective_deadline(deadline),
+            priority=priority)
         ticket.stop = [str(s) for s in stop or []]
         return ticket
 
@@ -1308,6 +1344,12 @@ def make_handler(state: ApiState):
             # back to the router-side ring (fleet correlation satellite)
             hop = self.headers.get("X-Dllama-Hop") or ""
             self._hop = _RID_RE.sub("", hop)[:_RID_MAX] or None
+            # QoS class from the transport header; the body field (when
+            # present) overrides it in do_POST.  An unknown header value
+            # is ignored — the router relays client headers verbatim and
+            # a typo'd class must not fail the request.
+            hdr = self.headers.get("X-Dllama-Priority")
+            self._prio_hdr = priority_level(hdr) if hdr else None
             set_request_id(rid)
             return rid
 
@@ -1660,7 +1702,7 @@ def make_handler(state: ApiState):
                 traced_op_times
             if state.draining:
                 self._json(503, {"error": "server is draining"},
-                           headers={"Retry-After": 30})
+                           headers={"Retry-After": jittered_retry_after(30)})
                 return
             q = parse_qs(query)
 
@@ -1752,18 +1794,20 @@ def make_handler(state: ApiState):
             try:
                 return state.sched_submit(
                     ids, max_tokens, temperature=temperature, top_p=top_p,
-                    eos_id=eos_id, deadline=deadline, stop=stop)
+                    eos_id=eos_id, deadline=deadline, stop=stop,
+                    priority=getattr(self, "_priority", 1))
             except ContextOverflow as e:
                 self._json(400, state.overflow_body(e))
             except SchedulerSaturated as e:
                 state.metrics.bump("requests_rejected_429")
                 self._json(429, state.overflow_body(e),
-                           headers={"Retry-After": state.retry_after_hint()})
+                           headers={"Retry-After": jittered_retry_after(
+                               state.retry_after_hint())})
             except SchedulerClosed:
                 state.metrics.bump("requests_rejected_503")
                 self._json(503, {"error": "server is draining; "
                                           "no new requests accepted"},
-                           headers={"Retry-After": 30})
+                           headers={"Retry-After": jittered_retry_after(30)})
             return None
 
         def _completions_sched(self, body: dict, deadline: float | None,
@@ -2028,13 +2072,14 @@ def make_handler(state: ApiState):
             except SchedulerSaturated as e:
                 state.metrics.bump("requests_rejected_429")
                 self._json(429, state.overflow_body(e),
-                           headers={"Retry-After": state.retry_after_hint()})
+                           headers={"Retry-After": jittered_retry_after(
+                               state.retry_after_hint())})
                 return
             except SchedulerClosed:
                 state.metrics.bump("requests_rejected_503")
                 self._json(503, {"error": "server is draining; "
                                           "no new requests accepted"},
-                           headers={"Retry-After": 30})
+                           headers={"Retry-After": jittered_retry_after(30)})
                 return
             obs_metrics.HANDOFF_IMPORTS.inc()
             self.send_response(200)
@@ -2099,6 +2144,38 @@ def make_handler(state: ApiState):
             body = self._read_body()
             if body is None:
                 return
+            # QoS class: body field wins over X-Dllama-Priority, default
+            # standard.  A malformed body value is a 400 (the header is
+            # lenient; the body is the caller's explicit contract).
+            prio_body = body.get("priority")
+            if prio_body is not None:
+                lvl = priority_level(prio_body)
+                if lvl is None:
+                    self._json(400, {
+                        "error": f"unknown priority class {prio_body!r}; "
+                                 "expected interactive|standard|batch"})
+                    return
+                self._priority = lvl
+            else:
+                self._priority = self._prio_hdr \
+                    if self._prio_hdr is not None \
+                    else PRIORITY_LEVELS["standard"]
+            prio_name = PRIORITY_NAMES.get(self._priority, "standard")
+            # SLO-driven shedding: drop best-effort admissions while the
+            # error budget burns, BEFORE this request counts against
+            # capacity (interactive traffic is never shed here)
+            if state.should_shed(self._priority):
+                state.metrics.bump("requests_rejected_429")
+                obs_metrics.ADMISSIONS_SHED.inc(prio_name)
+                _log.info("reject", extra={"status": 429,
+                                           "reason": "slo_shed",
+                                           "priority": prio_name})
+                self._json(429, {"error": "SLO error budget burning; "
+                                          f"shedding {prio_name}-class "
+                                          "admissions — retry later"},
+                           headers={"Retry-After": jittered_retry_after(
+                               state.retry_after_hint())})
+                return
             verdict = state.try_enter()
             if verdict == "draining":
                 state.metrics.bump("requests_rejected_503")
@@ -2106,7 +2183,7 @@ def make_handler(state: ApiState):
                                            "reason": "draining"})
                 self._json(503, {"error": "server is draining; "
                                           "no new requests accepted"},
-                           headers={"Retry-After": 30})
+                           headers={"Retry-After": jittered_retry_after(30)})
                 return
             if verdict == "full":
                 state.metrics.bump("requests_rejected_429")
@@ -2114,7 +2191,8 @@ def make_handler(state: ApiState):
                 self._json(429, {"error": f"server at capacity "
                                           f"({state.max_pending} requests "
                                           "pending); retry later"},
-                           headers={"Retry-After": state.retry_after_hint()})
+                           headers={"Retry-After": jittered_retry_after(
+                               state.retry_after_hint())})
                 return
             t0 = time.monotonic()
             tp0 = time.perf_counter()
@@ -2125,9 +2203,11 @@ def make_handler(state: ApiState):
             # its per-dispatch detail into this same record by request ID
             # (hop = the router's ring id, for cross-fleet correlation)
             if getattr(self, "_hop", None):
-                obs_flight.submit(self._rid, path=self.path, hop=self._hop)
+                obs_flight.submit(self._rid, path=self.path, hop=self._hop,
+                                  priority=prio_name)
             else:
-                obs_flight.submit(self._rid, path=self.path)
+                obs_flight.submit(self._rid, path=self.path,
+                                  priority=prio_name)
             ok = False
             try:
                 locked = False
@@ -2487,14 +2567,19 @@ def main(argv=None):
                 max_wait_ms=args.sched_max_wait_ms,
                 max_queue=args.sched_max_queue,
                 prefix_reuse=not args.no_prefix_reuse,
-                overlap=not args.no_sched_overlap)
+                overlap=not args.no_sched_overlap,
+                preempt=not args.no_preempt,
+                preempt_age_ms=args.preempt_age_ms,
+                preempt_cap=args.preempt_cap,
+                spill_dir=args.preempt_spill_dir)
             _log.info("slot_scheduler_enabled", extra={
                 "slots": args.batch_slots,
                 "prefill_chunk": args.sched_prefill_chunk,
                 "max_wait_ms": args.sched_max_wait_ms,
                 "paged": scheduler.paged,
                 "prefix_reuse": scheduler.prefix_cache is not None,
-                "overlap": scheduler.overlap})
+                "overlap": scheduler.overlap,
+                "preempt": scheduler.preempt and scheduler.paged})
         except ValueError as e:
             # quantized KV / sp mesh: lockstep batch serving still works,
             # only decode-step admission is off

@@ -1,0 +1,587 @@
+"""Multi-tenant QoS tests: priority classes, preemption, SLO shedding.
+
+The QoS contracts (docs/SERVING.md QoS section), each pinned here on
+CPU with the tiny model:
+
+* **priority-ordered admission** — with contention, a queued
+  ``interactive`` request admits before an earlier-queued ``batch`` one;
+* **preempt → park → re-admit byte parity** — a batch request preempted
+  mid-decode by an interactive burst (DLREQ01 park, pages freed) resumes
+  and finishes byte-identical to its uncontended solo run, with the
+  two-deep overlapped dispatch pipeline both on and off, and the pool
+  ends with zero leaked pages;
+* **starvation bound** — ``--preempt-age-ms`` ages a waiting request's
+  effective level so batch eventually beats fresh interactive arrivals;
+* **bounded preemption** — ``--preempt-cap`` / parked-area exhaustion
+  retire the victim with the honest ``finish_reason="preempted"``
+  instead of parking it forever;
+* **SLO shed order** — under a burning fast window only ``batch`` is
+  shed (429 + jittered Retry-After); a full ``violating`` verdict sheds
+  ``standard`` too; ``interactive`` is never shed;
+* **router scoring** — an SLO-violating replica is penalized for
+  batch/standard dispatch but stays fully scored for interactive;
+* **exposition** — the three new metric families surface in both
+  /metrics formats, and flight records carry priority / preempt_count.
+"""
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from fixtures import free_port, write_tiny_tokenizer
+
+from dllama_tpu.models.config import tiny_config
+from dllama_tpu.models.params import init_params
+from dllama_tpu.obs import metrics as obs_metrics
+from dllama_tpu.parallel.mesh import make_mesh
+from dllama_tpu.runtime.engine import Engine
+from dllama_tpu.runtime.faults import FAULTS
+from dllama_tpu.runtime.scheduler import (PRIORITY_LEVELS, PRIORITY_NAMES,
+                                          SlotScheduler)
+from dllama_tpu.server.backoff import JITTER_FRAC, jittered_retry_after
+
+pytestmark = pytest.mark.qos
+
+CFG = tiny_config(seq_len=64)
+PAGE = 4
+P1 = [5, 9, 2]
+P2 = [7, 3, 11, 4, 6, 1, 8]
+P3 = [2, 4, 6]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+def make_engine(batch=1):
+    return Engine(CFG, init_params(CFG, seed=4),
+                  mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+                  batch=batch)
+
+
+def make_paged_engine(batch=2, page=PAGE):
+    pages_per_slot = -(-CFG.seq_len // page)
+    return Engine(CFG, init_params(CFG, seed=4),
+                  mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+                  batch=batch,
+                  kv_pages=batch * pages_per_slot + 1,
+                  kv_page_size=page)
+
+
+@pytest.fixture(scope="module")
+def solo_refs():
+    """Greedy solo completions per prompt — the parity oracle."""
+    eng = make_engine()
+    refs = {}
+    for p in (P1, P2, P3):
+        eng.reset()
+        toks = [t for t, _ in eng.generate_stream(
+            p, len(p) + 30, temperature=0.0, chunk=5)]
+        refs[tuple(p)] = toks[len(p):]
+    return refs
+
+
+# --- unit: priority parsing + Retry-After jitter --------------------------
+
+def test_priority_level_parsing():
+    from dllama_tpu.server.api import priority_level
+    assert priority_level("interactive") == 0
+    assert priority_level("Standard") == 1
+    assert priority_level(" BATCH ") == 2
+    assert priority_level("turbo") is None
+    assert priority_level(None) is None
+    assert priority_level(3) is None
+    assert PRIORITY_NAMES[PRIORITY_LEVELS["batch"]] == "batch"
+
+
+def test_retry_after_jitter_bounds():
+    rng = random.Random(7)
+    draws = {int(jittered_retry_after(8, rng)) for _ in range(500)}
+    lo, hi = 8 * (1 - JITTER_FRAC), 8 * (1 + JITTER_FRAC)
+    assert min(draws) >= int(lo) and max(draws) <= round(hi)
+    assert len(draws) > 1, "jitter must actually spread the hint"
+    # floor: tiny/zero/garbage bases still return a valid >=1s hint
+    for bad in (0, -3, "0.2", None, "soon"):
+        assert int(jittered_retry_after(bad, rng)) >= 1
+
+
+def test_shed_order_unit():
+    """Interactive never sheds; batch sheds on a burning fast window;
+    standard only on a full violating verdict."""
+    from dllama_tpu.server.api import ApiState
+
+    class Shim:  # just enough of ApiState for should_shed
+        def __init__(self, verdict):
+            self.slo = type("S", (), {
+                "evaluate": staticmethod(lambda v=verdict: v)})()
+
+    burning = {"status": "ok", "windows": ["30s", "5m"],
+               "objectives": {"ttft_p95": {"burn": {"30s": 1.4, "5m": 0.2}}}}
+    violating = {"status": "violating", "windows": ["30s", "5m"],
+                 "objectives": {"ttft_p95": {"burn": {"30s": 2.0,
+                                                      "5m": 1.1}}}}
+    calm = {"status": "ok", "windows": ["30s", "5m"],
+            "objectives": {"ttft_p95": {"burn": {"30s": 0.1, "5m": 0.0}}}}
+    shed = ApiState.should_shed
+    for lvl in PRIORITY_LEVELS.values():
+        assert not shed(Shim(calm), lvl)
+    assert shed(Shim(burning), PRIORITY_LEVELS["batch"])
+    assert not shed(Shim(burning), PRIORITY_LEVELS["standard"])
+    assert not shed(Shim(burning), PRIORITY_LEVELS["interactive"])
+    assert shed(Shim(violating), PRIORITY_LEVELS["batch"])
+    assert shed(Shim(violating), PRIORITY_LEVELS["standard"])
+    assert not shed(Shim(violating), PRIORITY_LEVELS["interactive"])
+
+
+def test_router_score_keeps_violating_replica_for_interactive():
+    from dllama_tpu.router.registry import Backend, Registry
+    reg = Registry(["127.0.0.1:1", "127.0.0.1:2"], probe_interval=3600)
+    burning, calm = reg.backends
+    burning.last_health = {"status": "serving", "slo": {"status":
+                                                        "violating"},
+                           "capacity": {"free_slots": 4, "queue_depth": 0}}
+    calm.last_health = {"status": "serving", "slo": {"status": "ok"},
+                        "capacity": {"free_slots": 1, "queue_depth": 0}}
+    # low-priority dispatch avoids the burning replica...
+    assert reg.pick() is calm
+    assert reg.pick(priority="batch") is calm
+    # ...but interactive sees its real (larger) capacity
+    assert reg.pick(priority="interactive") is burning
+    # degraded kernels penalize EVERY class — only the SLO penalty is
+    # priority-conditional
+    burning.last_health["degraded"] = True
+    assert reg.pick(priority="interactive") is calm
+
+
+# --- unit: metric exposition (both formats) -------------------------------
+
+def test_qos_metrics_in_both_formats():
+    obs_metrics.SCHED_PREEMPTIONS.inc("no_free_slot")
+    obs_metrics.SCHED_PREEMPT_PARKED.set(2)
+    obs_metrics.ADMISSIONS_SHED.inc("batch")
+    snap = obs_metrics.snapshot_json()
+    assert snap["sched_preemptions"]["no_free_slot"] >= 1
+    assert snap["sched_preempt_parked"] == 2
+    assert snap["admissions_shed"]["batch"] >= 1
+    text = obs_metrics.render_prometheus()
+    assert 'dllama_sched_preemptions_total{reason="no_free_slot"}' in text
+    assert "dllama_sched_preempt_parked" in text
+    assert 'dllama_admissions_shed_total{class="batch"}' in text
+    obs_metrics.SCHED_PREEMPT_PARKED.set(0)
+
+
+# --- scheduler: ordering, aging, preemption -------------------------------
+
+def test_priority_ordered_admission(solo_refs):
+    """One slot, no preemption: a later-queued interactive request
+    admits (and therefore finishes) before an earlier-queued batch one."""
+    eng = make_engine(1)
+    sched = SlotScheduler(eng, prefill_chunk=4, decode_burst=4,
+                          preempt=False, preempt_age_ms=0.0)
+    try:
+        done: dict = {}
+
+        def run(key, prompt, prio):
+            t = sched.submit(prompt, 8, priority=prio)
+            toks = list(t.tokens())
+            done[key] = (time.monotonic(), toks, t.finish)
+
+        hold = sched.submit(P2, 12)  # occupies the only slot
+        b = threading.Thread(target=run,
+                             args=("batch", P1, PRIORITY_LEVELS["batch"]))
+        b.start()
+        time.sleep(0.15)  # batch is queued first, beyond doubt
+        i = threading.Thread(
+            target=run, args=("inter", P3, PRIORITY_LEVELS["interactive"]))
+        i.start()
+        list(hold.tokens())
+        b.join(120)
+        i.join(120)
+        assert done["inter"][0] < done["batch"][0], \
+            "interactive must be admitted before the earlier batch request"
+        assert done["inter"][1] == solo_refs[tuple(P3)][:8]
+        assert done["batch"][1] == solo_refs[tuple(P1)][:8]
+    finally:
+        sched.close()
+
+
+def test_aging_bounds_starvation(solo_refs):
+    """A batch request that has waited past --preempt-age-ms outranks a
+    fresh interactive arrival: starvation is bounded by aging."""
+    eng = make_engine(1)
+    # 60ms per aging step: after ~150ms a batch request (level 2) has
+    # aged to level 0 and ties break by arrival time (it is older)
+    sched = SlotScheduler(eng, prefill_chunk=4, decode_burst=4,
+                          preempt=False, preempt_age_ms=60.0)
+    try:
+        done: dict = {}
+
+        def run(key, prompt, prio):
+            t = sched.submit(prompt, 8, priority=prio)
+            toks = list(t.tokens())
+            done[key] = (time.monotonic(), toks)
+
+        hold = sched.submit(P2, 12)
+        b = threading.Thread(target=run,
+                             args=("batch", P1, PRIORITY_LEVELS["batch"]))
+        b.start()
+        time.sleep(0.3)  # > 2×2 aging steps: batch is at level <= 0 now
+        i = threading.Thread(
+            target=run, args=("inter", P3, PRIORITY_LEVELS["interactive"]))
+        i.start()
+        list(hold.tokens())
+        b.join(120)
+        i.join(120)
+        assert done["batch"][0] < done["inter"][0], \
+            "an aged batch request must not starve behind fresh interactive"
+        assert done["batch"][1] == solo_refs[tuple(P1)][:8]
+        assert done["inter"][1] == solo_refs[tuple(P3)][:8]
+    finally:
+        sched.close()
+
+
+@pytest.mark.parametrize("overlap", [True, False],
+                         ids=["overlap", "no-overlap"])
+def test_preempt_park_resume_byte_parity(solo_refs, overlap):
+    """THE preemption acceptance: an interactive burst lands while every
+    slot decodes batch work → one batch slot is preempted (DLREQ01 park,
+    pages freed), the interactive request serves, and the victim resumes
+    to a byte-identical finish — with the overlapped dispatch pipeline
+    both on and off, and zero pages leaked at the end."""
+    eng = make_paged_engine(batch=2)
+    # prefix_reuse off: the end-state page audit must be exact (the
+    # radix cache legitimately retains prefix pages otherwise)
+    sched = SlotScheduler(eng, prefill_chunk=4, decode_burst=4,
+                          overlap=overlap, preempt=True,
+                          preempt_age_ms=0.0, prefix_reuse=False)
+    base = obs_metrics.snapshot_json().get("sched_preemptions") or {}
+    try:
+        done: dict = {}
+
+        def run(key, prompt, n, prio):
+            t = sched.submit(prompt, n, priority=prio)
+            done[key] = (list(t.tokens()), t.finish, t.preempt_count)
+
+        # slow decode keeps both batch requests on device long enough
+        FAULTS.install("engine.device_step=delay:0.05x1000")
+        b1 = threading.Thread(target=run, args=(
+            "b1", P1, 30, PRIORITY_LEVELS["batch"]))
+        b2 = threading.Thread(target=run, args=(
+            "b2", P2, 30, PRIORITY_LEVELS["batch"]))
+        b1.start()
+        b2.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if sched.occupancy()["active"] == 2:
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError("batch never saturated the slots")
+        time.sleep(0.3)  # both are mid-decode, bursts in flight
+        it = threading.Thread(target=run, args=(
+            "it", P3, 6, PRIORITY_LEVELS["interactive"]))
+        it.start()
+        it.join(120)
+        FAULTS.clear()
+        b1.join(240)
+        b2.join(240)
+
+        assert done["it"][0] == solo_refs[tuple(P3)][:6]
+        assert done["it"][1] == "length"
+        pre = obs_metrics.snapshot_json().get("sched_preemptions") or {}
+        bumped = sum(pre.values()) - sum(base.values())
+        assert bumped >= 1, "interactive must have preempted a batch slot"
+        victims = [k for k in ("b1", "b2") if done[k][2] >= 1]
+        assert victims, f"no ticket recorded a preemption: {done}"
+        for k, p in (("b1", P1), ("b2", P2)):
+            toks, finish, _ = done[k]
+            assert finish == "length", (k, finish)
+            assert toks == solo_refs[tuple(p)][:30], \
+                f"{k} drifted after resume"
+        occ = sched.occupancy()
+        assert occ["active"] == 0 and occ["parked"] == 0, occ
+        assert occ["kv_pages_free"] == occ["kv_pages_total"], \
+            f"page leak: {occ}"
+        sched.pool.check()  # raises on any refcount/free-list violation
+    finally:
+        FAULTS.clear()
+        sched.close()
+
+
+def test_preempt_cap_retires_with_honest_finish():
+    """preempt_cap=0: the victim cannot be parked, so preemption retires
+    it with finish_reason="preempted" and its partial output intact."""
+    eng = make_paged_engine(batch=1)
+    sched = SlotScheduler(eng, prefill_chunk=4, decode_burst=2,
+                          preempt=True, preempt_cap=0, preempt_age_ms=0.0)
+    try:
+        FAULTS.install("engine.device_step=delay:0.05x1000")
+        victim = sched.submit(P2, 30, priority=PRIORITY_LEVELS["batch"])
+        got: list = []
+        t = threading.Thread(target=lambda: got.extend(victim.tokens()))
+        t.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if sched.occupancy()["active"] == 1:
+                break
+            time.sleep(0.01)
+        time.sleep(0.2)
+        inter = sched.submit(P3, 4, priority=PRIORITY_LEVELS["interactive"])
+        out = list(inter.tokens())
+        FAULTS.clear()
+        t.join(120)
+        assert victim.finish == "preempted", victim.finish
+        assert len(got) < 30, "victim must have been cut short"
+        assert len(out) == 4 and inter.finish == "length"
+        occ = sched.occupancy()
+        assert occ["parked"] == 0 and \
+            occ["kv_pages_free"] == occ["kv_pages_total"], occ
+    finally:
+        FAULTS.clear()
+        sched.close()
+
+
+def test_parked_area_full_retires_with_honest_finish():
+    """parked_max=0: nowhere to park → same honest "preempted" finish."""
+    eng = make_paged_engine(batch=1)
+    sched = SlotScheduler(eng, prefill_chunk=4, decode_burst=2,
+                          preempt=True, parked_max=0, preempt_age_ms=0.0)
+    try:
+        FAULTS.install("engine.device_step=delay:0.05x1000")
+        victim = sched.submit(P2, 30, priority=PRIORITY_LEVELS["batch"])
+        t = threading.Thread(target=lambda: list(victim.tokens()))
+        t.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if sched.occupancy()["active"] == 1:
+                break
+            time.sleep(0.01)
+        time.sleep(0.2)
+        inter = sched.submit(P3, 4, priority=PRIORITY_LEVELS["interactive"])
+        assert len(list(inter.tokens())) == 4
+        FAULTS.clear()
+        t.join(120)
+        assert victim.finish == "preempted", victim.finish
+    finally:
+        FAULTS.clear()
+        sched.close()
+
+
+@pytest.mark.slow
+def test_preempt_spill_dir_roundtrip(solo_refs, tmp_path):
+    """--preempt-spill-dir: the parked DLREQ01 record round-trips through
+    disk and the resume is still byte-identical."""
+    eng = make_paged_engine(batch=1)
+    sched = SlotScheduler(eng, prefill_chunk=4, decode_burst=2,
+                          preempt=True, preempt_age_ms=0.0,
+                          spill_dir=str(tmp_path))
+    try:
+        FAULTS.install("engine.device_step=delay:0.05x1000")
+        victim = sched.submit(P2, 30, priority=PRIORITY_LEVELS["batch"])
+        got: list = []
+        t = threading.Thread(target=lambda: got.extend(victim.tokens()))
+        t.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if sched.occupancy()["active"] == 1:
+                break
+            time.sleep(0.01)
+        time.sleep(0.2)
+        inter = sched.submit(P3, 4, priority=PRIORITY_LEVELS["interactive"])
+        spilled = []
+        while inter.finish is None:
+            spilled.extend(str(p) for p in tmp_path.glob("*.dlreq"))
+            time.sleep(0.01)
+        list(inter.tokens())
+        FAULTS.clear()
+        t.join(240)
+        assert spilled, "the parked record must have hit the spill dir"
+        assert victim.finish == "length"
+        assert got == solo_refs[tuple(P2)][:30], "resume drift after spill"
+        assert not list(tmp_path.glob("*.dlreq")), "spill file must be " \
+            "cleaned up after resume"
+    finally:
+        FAULTS.clear()
+        sched.close()
+
+
+# --- live in-process server: API surface + shedding -----------------------
+
+class FakeSlo:
+    """Stands in for obs.slo.SloEngine: evaluate() returns whatever
+    verdict the test has loaded."""
+
+    def __init__(self):
+        self.verdict = {"status": "ok", "windows": ["30s", "5m"],
+                        "objectives": {}}
+
+    def observe_ttft(self, *a, **k):
+        pass
+
+    def observe_itl(self, *a, **k):
+        pass
+
+    def evaluate(self):
+        return self.verdict
+
+    def burn(self, fast, slow=0.0):
+        self.verdict = {
+            "status": "violating" if slow >= 1.0 else "ok",
+            "windows": ["30s", "5m"],
+            "objectives": {"ttft_p95": {"burn": {"30s": fast,
+                                                 "5m": slow}}}}
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    from dllama_tpu.tokenizer.bpe import Tokenizer
+    d = tmp_path_factory.mktemp("qos")
+    tok = Tokenizer(write_tiny_tokenizer(str(d / "tok.t")))
+    cfg = tiny_config(seq_len=128, vocab_size=300)
+    eng = Engine(cfg, init_params(cfg, seed=4),
+                 mesh=make_mesh(tp=1, devices=jax.devices()[:1]), batch=2)
+    return eng, tok
+
+
+@pytest.fixture
+def api(stack):
+    from dllama_tpu.server.api import ApiState, serve
+    servers = []
+
+    def make(**kw):
+        eng, tok = stack
+        state = ApiState(eng, tok, default_temperature=0.0, chunk=2,
+                         batch_engine=eng, **kw)
+        srv = serve(state, host="127.0.0.1", port=free_port(), block=False)
+        servers.append(srv)
+        return state, f"http://127.0.0.1:{srv.server_address[1]}"
+
+    yield make
+    for s in servers:
+        s.shutdown()
+        s.server_close()
+
+
+def post(base, path, body, headers=None):
+    req = urllib.request.Request(
+        base + path, json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    return urllib.request.urlopen(req, timeout=120)
+
+
+def test_shed_order_live(api):
+    """End-to-end shed order through the HTTP surface: batch sheds on a
+    burning fast window (429 + Retry-After + admissions_shed metric),
+    standard follows only on a violating verdict, interactive never."""
+    slo = FakeSlo()
+    _, base = api(slo=slo)
+    body = {"prompt": "hello", "max_tokens": 2}
+
+    with post(base, "/v1/completions", dict(body, priority="batch")) as r:
+        assert r.status == 200  # calm SLO: nothing sheds
+
+    slo.burn(fast=1.5)  # fast window burning, slow window fine
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(base, "/v1/completions", dict(body, priority="batch"))
+    assert ei.value.code == 429
+    assert int(ei.value.headers["Retry-After"]) >= 1
+    # the header route sheds identically (router-propagated class)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(base, "/v1/completions", body,
+             headers={"X-Dllama-Priority": "batch"})
+    assert ei.value.code == 429
+    with post(base, "/v1/completions",
+              dict(body, priority="standard")) as r:
+        assert r.status == 200
+    # the chat surface honors the same class field
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(base, "/v1/chat/completions",
+             {"messages": [{"role": "user", "content": "hi"}],
+              "max_tokens": 2, "priority": "batch"})
+    assert ei.value.code == 429
+
+
+def test_shed_order_live_violating(api):
+    slo = FakeSlo()
+    _, base = api(slo=slo)
+    body = {"prompt": "hello", "max_tokens": 2}
+    slo.burn(fast=2.0, slow=1.2)  # full violating verdict
+    for cls in ("batch", "standard"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(base, "/v1/completions", dict(body, priority=cls))
+        assert ei.value.code == 429, cls
+    with post(base, "/v1/completions",
+              dict(body, priority="interactive")) as r:
+        data = json.loads(r.read())
+        assert data["choices"][0]["finish_reason"] in ("stop", "length")
+    shed = obs_metrics.snapshot_json().get("admissions_shed") or {}
+    assert shed.get("batch", 0) >= 1 and shed.get("standard", 0) >= 1
+    assert "interactive" not in shed
+
+
+def test_unknown_priority_body_is_400(api):
+    _, base = api()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(base, "/v1/completions",
+             {"prompt": "x", "max_tokens": 2, "priority": "turbo"})
+    assert ei.value.code == 400
+    assert "unknown priority class" in ei.value.read().decode()
+    # an unknown HEADER (router forwards what it saw) degrades to the
+    # default class instead of erroring
+    with post(base, "/v1/completions", {"prompt": "x", "max_tokens": 2},
+              headers={"X-Dllama-Priority": "turbo"}) as r:
+        assert r.status == 200
+
+
+def test_flight_record_carries_priority(api):
+    _, base = api()
+    with post(base, "/v1/completions",
+              {"prompt": "hello", "max_tokens": 2,
+               "priority": "interactive"}) as r:
+        rid = r.headers.get("X-Request-Id")
+        assert rid
+    with urllib.request.urlopen(base + f"/debug/requests/{rid}",
+                                timeout=30) as r:
+        rec = json.loads(r.read())
+    assert rec["priority"] == "interactive"
+    with urllib.request.urlopen(base + "/debug/requests?n=5",
+                                timeout=30) as r:
+        rows = json.loads(r.read())["requests"]
+    mine = [x for x in rows if x["request_id"] == rid]
+    assert mine and mine[0]["priority"] == "interactive"
+    assert "preempt_count" in mine[0]
+
+
+# --- trace replay harness (tools/trace_replay.py) -------------------------
+
+def test_trace_replay_units():
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import trace_replay as tr
+    mix = tr.parse_mix("interactive=1,standard=2,batch=1")
+    assert [name for name, _ in mix] == ["interactive", "standard", "batch"]
+    assert mix[-1][1] == pytest.approx(1.0)
+    with pytest.raises(ValueError):
+        tr.parse_mix("turbo=1")
+    rng = random.Random(3)
+    names = {tr._assign(mix, rng) for _ in range(200)}
+    assert names == {"interactive", "standard", "batch"}
+    t1 = tr.synth_trace(16, 4.0, seed=9)
+    t2 = tr.synth_trace(16, 4.0, seed=9)
+    assert t1 == t2, "synthetic traces must be reproducible"
+    assert len(t1["requests"]) == 16
+    offs = [r["offset_s"] for r in t1["requests"]]
+    assert offs == sorted(offs) and offs[0] == 0.0
+    assert tr._pct([1.0, 2.0, 3.0, 4.0], 0.5) == 3.0
+    assert tr._pct([], 0.95) is None

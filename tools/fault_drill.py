@@ -446,6 +446,89 @@ def drill_page_exhaustion(model, tok):
         s.stop()
 
 
+def drill_priority_preempt(model, tok):
+    """Saturate every slot with batch-class decodes, then land an
+    interactive burst: the scheduler must admit it by preempting a batch
+    slot (DLREQ01 park), the preempted request must resume and finish
+    byte-identical to its uncontended solo run (no re-prefill drift),
+    and the pool must end with zero leaked pages."""
+    # 2 slots, 31 usable pages (a 40-token batch decode holds ~12, so two
+    # fit but a third request finds no free slot); the per-step delay
+    # keeps the batch decodes on device long enough to be preempted.
+    # --no-prefix-reuse keeps the end-state page audit exact.
+    s = Server(model, tok, faults="engine.device_step=delay:0.15",
+               extra_flags=["--batch-slots", "2", "--kv-pages", "32",
+                            "--kv-page-size", "4", "--no-prefix-reuse"])
+    try:
+        s.wait_ready()
+        total = get(s.base, "/health")["scheduler"]["kv_pages_total"]
+        batch_bodies = [
+            {"prompt": "Once upon a time", "max_tokens": 40,
+             "priority": "batch"},
+            {"prompt": "The quick brown fox", "max_tokens": 40,
+             "priority": "batch"}]
+        # solo greedy references, served with zero contention: the oracle
+        # a preempted-and-resumed request must match byte for byte
+        refs = []
+        for body in batch_bodies:
+            with post_to(s.base, "/v1/completions", body) as r:
+                refs.append(json.loads(r.read())["choices"][0]["text"])
+
+        results: dict = {}
+
+        def run(key, body):
+            with post_to(s.base, "/v1/completions", body) as r:
+                results[key] = json.loads(r.read())["choices"][0]
+
+        bts = [threading.Thread(target=run, args=(f"batch{i}", body))
+               for i, body in enumerate(batch_bodies)]
+        for t in bts:
+            t.start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:  # both slots decoding batch
+            if get(s.base, "/health")["scheduler"]["active"] >= 2:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("batch requests never filled the slots")
+        # the interactive burst: no free slot → must preempt, not queue
+        it = threading.Thread(target=run, args=(
+            "inter", {"prompt": "hi", "max_tokens": 8,
+                      "priority": "interactive"}))
+        it.start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            pre = get(s.base, "/metrics").get("sched_preemptions") or {}
+            if sum(pre.values()) >= 1:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("interactive never triggered a preemption")
+        assert pre.get("no_free_slot", 0) >= 1, pre
+        it.join(240)
+        for t in bts:
+            t.join(300)
+        assert results["inter"]["finish_reason"] in ("stop", "length"), \
+            results["inter"]
+        # the preempted batch request resumed from its parked DLREQ01
+        # record: same bytes as the solo oracle, honest finish_reason
+        for i in range(2):
+            c = results[f"batch{i}"]
+            assert c["finish_reason"] in ("stop", "length"), c
+            assert c["text"] == refs[i], \
+                f"resume drift on batch{i}:\n {c['text']!r}\n != {refs[i]!r}"
+        # the flight recorder kept the preemption story
+        recs = get(s.base, "/debug/requests?n=20")["requests"]
+        preempted = [r for r in recs if (r.get("preempt_count") or 0) >= 1]
+        assert preempted and preempted[0]["priority"] == "batch", recs
+        occ = get(s.base, "/health")["scheduler"]
+        assert occ["active"] == 0 and occ["queued"] == 0, occ
+        assert occ["parked"] == 0, occ
+        assert occ["kv_pages_free"] == total, f"page leak: {occ}"
+    finally:
+        s.stop()
+
+
 def drill_slo_burn(model, tok):
     """An injected per-dispatch delay burns the ITL error budget: /health
     flips to violating with slo_violations_total >= 1, then recovers to
@@ -717,6 +800,7 @@ DRILLS = {
     "latency_histogram": drill_latency_histogram,
     "slot_churn": drill_slot_churn,
     "page_exhaustion": drill_page_exhaustion,
+    "priority_preempt": drill_priority_preempt,
     "slo_burn": drill_slo_burn,
     "overlap_stall": drill_overlap_stall,
     "replica_failover": drill_replica_failover,

@@ -82,6 +82,22 @@ def summarize(doc: dict) -> str:
     lines = [f"{len(spans)} spans across {len(rids)} request(s):"]
     for name, n in counts.most_common():
         lines.append(f"  {name:<16} x{n:<5} {total_ms[name]:9.1f} ms total")
+    # QoS story: admissions per priority class, plus the preempt/resume
+    # pairs with time spent parked (sched_preempt / sched_resume spans
+    # carry priority, reason and parked_ms in their args)
+    admits = Counter(e["args"].get("priority") or "?"
+                     for e in spans if e["name"] == "sched_admit")
+    preempts = Counter(e["args"].get("reason") or "?"
+                       for e in spans if e["name"] == "sched_preempt")
+    parked_ms = sum(e["args"].get("parked_ms") or 0.0
+                    for e in spans if e["name"] == "sched_resume")
+    if admits:
+        mix = " ".join(f"{k}={v}" for k, v in admits.most_common())
+        lines.append(f"  admits by class: {mix}")
+    if preempts:
+        why = " ".join(f"{k}={v}" for k, v in preempts.most_common())
+        lines.append(f"  preemptions: {why}; "
+                     f"{parked_ms:.0f} ms total parked")
     return "\n".join(lines)
 
 

@@ -1,0 +1,368 @@
+#!/usr/bin/env python
+"""Trace-record / trace-replay overload harness for multi-tenant QoS.
+
+Two subcommands against a live replica (or the fleet router):
+
+``record``
+    Pull arrival history from ``GET /debug/requests`` (the flight
+    recorder) and write a replayable trace: one row per request with its
+    arrival offset, prompt size, token budget, and priority class.
+
+``replay``
+    Fire the trace back at the server at ``--speed N`` (N× compressed
+    inter-arrival gaps), optionally re-assigning priority classes from a
+    ``--mix`` distribution, and report what each class experienced:
+    per-class TTFT / inter-token-latency percentiles, finish-reason
+    counts (including honest ``preempted`` finishes), 429 sheds, and the
+    server's preemption / shed counter deltas read from ``/metrics``.
+    With ``--slo-ttft-ms`` the report carries a per-class verdict so a
+    drill can assert "interactive held its budget while batch absorbed
+    the overload".
+
+Without ``--trace``, replay synthesizes an open-loop Poisson-ish trace
+(``--requests`` arrivals at ``--rate`` per second), which is the usual
+way to push a replica past capacity without first recording one.
+
+Usage::
+
+    python tools/trace_replay.py record --base http://127.0.0.1:8000 \
+        --out /tmp/trace.json
+    python tools/trace_replay.py replay --base http://127.0.0.1:8000 \
+        --trace /tmp/trace.json --speed 2 \
+        --mix interactive=0.2,standard=0.3,batch=0.5 --slo-ttft-ms 2000
+
+Stdlib-only; exit code 0 iff every class with a configured SLO budget
+met it (always 0 when no budget was given).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+PRIORITIES = ("interactive", "standard", "batch")
+
+#: metric families whose deltas the report surfaces (JSON /metrics keys)
+_COUNTER_FAMILIES = ("sched_preemptions", "admissions_shed",
+                     "requests_rejected_429")
+
+
+# -- trace shape ----------------------------------------------------------
+def _get_json(base: str, path: str, timeout: float = 10.0):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def record_trace(base: str, n: int = 500) -> dict:
+    """Build a trace from the server's flight recorder (newest-first
+    summaries are re-sorted into arrival order; offsets are relative to
+    the oldest arrival)."""
+    recs = _get_json(base, f"/debug/requests?n={n}").get("requests") or []
+    rows = [r for r in recs if r.get("submitted_at") is not None]
+    rows.sort(key=lambda r: r["submitted_at"])
+    if not rows:
+        return {"version": 1, "requests": []}
+    t0 = rows[0]["submitted_at"]
+    out = []
+    for r in rows:
+        out.append({
+            "offset_s": round(r["submitted_at"] - t0, 6),
+            "prompt_tokens": int(r.get("n_prompt") or 8),
+            "max_tokens": max(1, int(r.get("produced") or 16)),
+            "priority": r.get("priority") or "standard",
+        })
+    return {"version": 1, "recorded_from": base, "requests": out}
+
+
+def synth_trace(n: int, rate: float, *, max_tokens: int = 16,
+                prompt_tokens: int = 8, seed: int = 0) -> dict:
+    """Open-loop arrivals: exponential gaps at ``rate``/s (deterministic
+    under ``seed`` so drills are reproducible)."""
+    rng = random.Random(seed)
+    t, rows = 0.0, []
+    for _ in range(max(1, n)):
+        rows.append({"offset_s": round(t, 6),
+                     "prompt_tokens": prompt_tokens,
+                     "max_tokens": max_tokens,
+                     "priority": "standard"})
+        t += rng.expovariate(rate) if rate > 0 else 0.0
+    return {"version": 1, "requests": rows}
+
+
+def parse_mix(spec: str) -> list[tuple[str, float]]:
+    """``interactive=0.2,standard=0.3,batch=0.5`` → cumulative weights."""
+    weights = []
+    for part in spec.split(","):
+        name, _, w = part.partition("=")
+        name = name.strip().lower()
+        if name not in PRIORITIES:
+            raise ValueError(f"unknown priority class {name!r} in --mix; "
+                             f"expected {'|'.join(PRIORITIES)}")
+        weights.append((name, float(w)))
+    total = sum(w for _, w in weights)
+    if total <= 0:
+        raise ValueError("--mix weights must sum to a positive value")
+    acc, out = 0.0, []
+    for name, w in weights:
+        acc += w / total
+        out.append((name, acc))
+    return out
+
+
+def _assign(mix, rng) -> str:
+    x = rng.random()
+    for name, cum in mix:
+        if x <= cum:
+            return name
+    return mix[-1][0]
+
+
+# -- one streamed request -------------------------------------------------
+class _Result:
+    __slots__ = ("priority", "status", "ttft_s", "itl", "finish", "error")
+
+    def __init__(self, priority):
+        self.priority = priority
+        self.status = None          # HTTP status (int) or None on error
+        self.ttft_s = None
+        self.itl: list[float] = []
+        self.finish = None
+        self.error = None
+
+
+def _one_request(base: str, row: dict, priority: str, timeout: float,
+                 results: list, lock: threading.Lock) -> None:
+    res = _Result(priority)
+    prompt = "replay " * max(1, row.get("prompt_tokens", 8) // 2)
+    body = json.dumps({"prompt": prompt.strip(),
+                       "max_tokens": row.get("max_tokens", 16),
+                       "stream": True,
+                       "priority": priority}).encode()
+    req = urllib.request.Request(
+        base + "/v1/completions", body,
+        headers={"Content-Type": "application/json"})
+    t0 = time.monotonic()
+    last = None
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            res.status = r.status
+            for raw in r:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line.startswith("data: "):
+                    continue
+                payload = line[len("data: "):]
+                if payload == "[DONE]":
+                    break
+                now = time.monotonic()
+                if res.ttft_s is None:
+                    res.ttft_s = now - t0
+                elif last is not None:
+                    res.itl.append(now - last)
+                last = now
+                try:
+                    chunk = json.loads(payload)
+                except ValueError:
+                    continue
+                for c in chunk.get("choices") or []:
+                    if c.get("finish_reason"):
+                        res.finish = c["finish_reason"]
+                if "error" in chunk:
+                    res.error = chunk["error"].get("message", "stream error")
+    except urllib.error.HTTPError as e:
+        res.status = e.code
+        try:
+            res.error = json.loads(e.read()).get("error")
+        except Exception:
+            res.error = f"http {e.code}"
+    except OSError as e:
+        res.error = str(e)
+    with lock:
+        results.append(res)
+
+
+# -- replay + report ------------------------------------------------------
+def _pct(sorted_vals: list[float], q: float) -> float | None:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def _counter_totals(metrics: dict) -> dict:
+    """Flatten the families we care about into ``family`` /
+    ``family{label}`` scalar totals (labeled families arrive as dicts)."""
+    out = {}
+    for fam in _COUNTER_FAMILIES:
+        v = metrics.get(fam)
+        if isinstance(v, dict):
+            for label, n in v.items():
+                out[f"{fam}{{{label}}}"] = n
+            out[fam] = sum(v.values())
+        elif v is not None:
+            out[fam] = v
+    return out
+
+
+def replay_trace(base: str, trace: dict, *, speed: float = 1.0,
+                 mix: str | None = None, seed: int = 0,
+                 timeout: float = 240.0,
+                 slo_ttft_ms: float | None = None) -> dict:
+    """Replay ``trace`` against ``base`` and return the report dict
+    (also the library entry point used by tests and fault drills)."""
+    rows = trace.get("requests") or []
+    if not rows:
+        raise ValueError("trace has no requests")
+    rng = random.Random(seed)
+    mix_cum = parse_mix(mix) if mix else None
+    before = _counter_totals(_get_json(base, "/metrics"))
+
+    results: list[_Result] = []
+    lock = threading.Lock()
+    threads = []
+    t_start = time.monotonic()
+    for row in rows:
+        prio = _assign(mix_cum, rng) if mix_cum \
+            else (row.get("priority") or "standard")
+        due = t_start + row.get("offset_s", 0.0) / max(speed, 1e-9)
+        delay = due - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        t = threading.Thread(target=_one_request,
+                             args=(base, row, prio, timeout, results, lock),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout)
+    wall = time.monotonic() - t_start
+
+    after = _counter_totals(_get_json(base, "/metrics"))
+    deltas = {k: after.get(k, 0) - before.get(k, 0)
+              for k in sorted(set(before) | set(after))
+              if after.get(k, 0) != before.get(k, 0)}
+
+    classes = {}
+    for name in PRIORITIES:
+        rs = [r for r in results if r.priority == name]
+        if not rs:
+            continue
+        ttfts = sorted(r.ttft_s for r in rs if r.ttft_s is not None)
+        itls = sorted(g for r in rs for g in r.itl)
+        finishes: dict[str, int] = {}
+        for r in rs:
+            if r.finish:
+                finishes[r.finish] = finishes.get(r.finish, 0) + 1
+        row = {
+            "sent": len(rs),
+            "ok": sum(1 for r in rs if r.status == 200 and not r.error),
+            "shed_429": sum(1 for r in rs if r.status == 429),
+            "errors": sum(1 for r in rs
+                          if r.error and r.status not in (200, 429)),
+            "finish_reasons": finishes,
+            "ttft_p50_ms": round(_pct(ttfts, 0.5) * 1e3, 1) if ttfts
+            else None,
+            "ttft_p95_ms": round(_pct(ttfts, 0.95) * 1e3, 1) if ttfts
+            else None,
+            "itl_p50_ms": round(_pct(itls, 0.5) * 1e3, 1) if itls else None,
+            "itl_p95_ms": round(_pct(itls, 0.95) * 1e3, 1) if itls else None,
+        }
+        if slo_ttft_ms is not None and name == "interactive":
+            row["slo_verdict"] = (
+                "pass" if ttfts and row["ttft_p95_ms"] <= slo_ttft_ms
+                else "fail")
+        classes[name] = row
+
+    try:
+        slo = (_get_json(base, "/health").get("slo") or {}).get("status")
+    except Exception:
+        slo = None
+    return {"base": base, "speed": speed, "wall_s": round(wall, 3),
+            "requests": len(rows), "classes": classes,
+            "metric_deltas": deltas, "server_slo_status": slo}
+
+
+def print_report(report: dict) -> None:
+    print(f"replayed {report['requests']} requests at "
+          f"{report['speed']}x in {report['wall_s']}s "
+          f"against {report['base']}")
+    for name, c in report["classes"].items():
+        verdict = f"  slo={c['slo_verdict']}" if "slo_verdict" in c else ""
+        print(f"  {name:<12} sent={c['sent']:<4} ok={c['ok']:<4} "
+              f"shed429={c['shed_429']:<4} "
+              f"ttft p50/p95={c['ttft_p50_ms']}/{c['ttft_p95_ms']}ms "
+              f"itl p50/p95={c['itl_p50_ms']}/{c['itl_p95_ms']}ms "
+              f"finish={c['finish_reasons']}{verdict}")
+    if report["metric_deltas"]:
+        print("  server counter deltas:")
+        for k, v in report["metric_deltas"].items():
+            print(f"    {k:<40} +{v}")
+    if report.get("server_slo_status"):
+        print(f"  server SLO status: {report['server_slo_status']}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    rec = sub.add_parser("record", help="snapshot /debug/requests "
+                                        "arrivals into a trace file")
+    rec.add_argument("--base", required=True)
+    rec.add_argument("--out", required=True)
+    rec.add_argument("-n", type=int, default=500,
+                     help="max flight records to pull")
+
+    rep = sub.add_parser("replay", help="replay a trace (or a synthetic "
+                                        "overload) and report per-class "
+                                        "latency/shedding")
+    rep.add_argument("--base", required=True)
+    rep.add_argument("--trace", help="trace file from `record` "
+                                     "(default: synthesize)")
+    rep.add_argument("--speed", type=float, default=1.0,
+                     help="replay at N× recorded speed")
+    rep.add_argument("--mix", help="re-assign classes, e.g. "
+                                   "interactive=0.2,standard=0.3,batch=0.5")
+    rep.add_argument("--requests", type=int, default=32,
+                     help="synthetic trace size (no --trace)")
+    rep.add_argument("--rate", type=float, default=8.0,
+                     help="synthetic arrivals per second (no --trace)")
+    rep.add_argument("--max-tokens", type=int, default=16)
+    rep.add_argument("--seed", type=int, default=0)
+    rep.add_argument("--timeout", type=float, default=240.0)
+    rep.add_argument("--slo-ttft-ms", type=float, default=None,
+                     help="interactive TTFT p95 budget for the verdict")
+    rep.add_argument("--json", action="store_true",
+                     help="emit the raw report dict instead of text")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "record":
+        trace = record_trace(args.base, args.n)
+        with open(args.out, "w") as f:
+            json.dump(trace, f, indent=1)
+        print(f"recorded {len(trace['requests'])} arrivals -> {args.out}")
+        return 0
+
+    if args.trace:
+        with open(args.trace) as f:
+            trace = json.load(f)
+    else:
+        trace = synth_trace(args.requests, args.rate,
+                            max_tokens=args.max_tokens, seed=args.seed)
+    report = replay_trace(args.base, trace, speed=args.speed, mix=args.mix,
+                          seed=args.seed, timeout=args.timeout,
+                          slo_ttft_ms=args.slo_ttft_ms)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print_report(report)
+    verdicts = [c.get("slo_verdict") for c in report["classes"].values()]
+    return 1 if "fail" in verdicts else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
