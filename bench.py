@@ -441,6 +441,9 @@ def _bench_decode(cfg, chunk=32, n_chunks=10, profile=False, start_pos=0,
     # and the dispatch ledger: a decode number that fell off the fused
     # Pallas path must say so next to the number it degrades
     print(f"bench: {obs_dispatch.summary_line()}", file=sys.stderr)
+    coll = obs_dispatch.collective_line()
+    if coll:
+        print(f"bench: {coll}", file=sys.stderr)
     return float(np.mean(times))
 
 
@@ -482,7 +485,7 @@ def _bench_prefill(cfg, T=512, reps=6):
     return (time.perf_counter() - t0) * 1000 / reps / T
 
 
-def _bench_sched(cfg, slots=4, max_new=96):
+def _bench_sched(cfg, slots=4, max_new=96, tp=1):
     """Continuous-batching aggregate decode throughput (the serving path
     behind ``--batch-slots``, runtime/scheduler.py): ``slots`` staggered
     greedy requests admitted at decode-step granularity over one
@@ -491,7 +494,12 @@ def _bench_sched(cfg, slots=4, max_new=96):
     here requests JOIN while their neighbors are mid-decode, which is what
     /v1/completions traffic actually looks like.  Returns aggregate
     tok/s (completion tokens only — prefill is inside the window, as it is
-    for a real request)."""
+    for a real request).
+
+    ``tp`` > 1 runs the same workload on a tensor-parallel mesh (PR-12):
+    the scheduler's step loop samples the mesh's all-reduce latency into
+    ``engine_collective_ms`` as it serves, and the dispatch ledger
+    records whether decode collectives took the fused ring or psum."""
     import threading
 
     import jax
@@ -502,7 +510,8 @@ def _bench_sched(cfg, slots=4, max_new=96):
 
     params = maybe_blocked(_zero_q40_params(cfg))
     eng = Engine(cfg, params,
-                 mesh=make_mesh(tp=1, devices=jax.devices()[:1]), batch=slots)
+                 mesh=make_mesh(tp=tp, devices=jax.devices()[:tp]),
+                 batch=slots)
     sched = SlotScheduler(eng, prefill_chunk=16, max_wait_ms=20.0)
     rng = np.random.RandomState(7)
     prompts = [[int(t) for t in rng.randint(1, cfg.vocab_size, 8 + 4 * i)]
@@ -718,6 +727,40 @@ def run_attempt(name):
             "metric": "llama2-7b q40 prefill tok/s (1 TPU chip, T=512)",
             "value": round(1000.0 / ms, 1), "unit": "tok/s",
             "vs_baseline": None, "backend": jax.default_backend()}))
+        return
+
+    if name.endswith("-tp4sched4"):
+        # tensor-parallel serving (parallel/mesh.py + ops/q40.py): the
+        # -sched4 staggered workload on a tp=4 mesh — on CPU 4 of the 8
+        # forced virtual devices (psum fallback, ledger-recorded), on TPU
+        # 4 real chips with the fused collective-matmul ring.  Must be
+        # checked before -sched4: the suffix contains it.
+        base = name[:-10]
+        cfg = _model_cfg(base)
+        if base == "cpu-tiny":
+            impl = "xla"
+        else:
+            print(f"bench: {base}: claiming backend...", file=sys.stderr)
+            print(f"bench: {base}: backend {jax.default_backend()}",
+                  file=sys.stderr)
+            impl = _pallas_hw_check("q40")
+        if len(jax.devices()) < 4:
+            print(f"bench: {name}: needs 4 devices, have "
+                  f"{len(jax.devices())}", file=sys.stderr)
+            raise SystemExit(3)
+        toks = _bench_sched(cfg.with_(quant_impl=impl), tp=4)
+        from dllama_tpu.obs import metrics as obs_metrics
+        coll = obs_metrics.ENGINE_COLLECTIVE_MS
+        print(json.dumps({
+            "metric": f"{base} q40 tensor-parallel tp=4 continuous-batching "
+                      f"slots=4 aggregate decode tok/s "
+                      f"(staggered arrivals, {impl})",
+            "value": round(toks, 2), "unit": "tok/s",
+            "vs_baseline": round(toks / BASELINE_7B_TOKS, 2)
+            if base == "llama2-7b" else None,
+            "collective_ms_avg": round(coll.sum / coll.count, 3)
+            if coll.count else None,
+            "backend": jax.default_backend()}))
         return
 
     if name.endswith("-sched4"):
@@ -1316,6 +1359,18 @@ def main():
                     px_out.get("prefix_tokens_reused")
                 print(f"bench: prefix sharing: {json.dumps(px_out)}",
                       file=sys.stderr)
+        # tensor-parallel serving evidence: the sched4 workload on a tp=4
+        # mesh (4 chips) with the fused collective-matmul decode — the
+        # dispatch ledger in the attempt's stderr says whether the ring
+        # or the psum fallback actually ran
+        if got_7b and remaining() > RESERVE + 280 and _relay_up():
+            tp4_out = _spawn("llama2-7b-tp4sched4", 300)
+            if tp4_out:
+                extras["llama2-7b_tp4sched4_agg_toks"] = tp4_out["value"]
+                extras["llama2-7b_tp4sched4_collective_ms"] = \
+                    tp4_out.get("collective_ms_avg")
+                print(f"bench: tp serving: {json.dumps(tp4_out)}",
+                      file=sys.stderr)
         # int8-KV-cache long-context evidence: the 16k live-prefix decode
         # rerun with the quantized cache — the cache read dominates there,
         # so the delta vs llama2-7b_16k_toks measures the ~2× traffic cut
@@ -1469,6 +1524,21 @@ def main():
                 extras["cpu_prefix4_agg_toks"] = px["value"]
                 extras["cpu_prefix4_tokens_reused"] = \
                     px.get("prefix_tokens_reused")
+        if remaining() > 140:
+            # tensor-parallel serving on the same host: the sched4
+            # workload on a tp=4 mesh over 8 forced virtual devices —
+            # end-to-end through the sharded program (CPU takes the psum
+            # fallback; the fused ring is TPU-only and ledger-recorded)
+            tp4 = _spawn("cpu-tiny-tp4sched4", min(remaining() - 60, 360),
+                         env_extra=forced_cpu_env(8))
+            if tp4 and tp4.get("value"):
+                extras = extras or {}
+                extras["cpu_tp4sched4_agg_toks"] = tp4["value"]
+                extras["cpu_tp4sched4_collective_ms"] = \
+                    tp4.get("collective_ms_avg")
+                if extras.get("cpu_sched4_agg_toks"):
+                    extras["cpu_tp4sched4_vs_sched4"] = round(
+                        tp4["value"] / extras["cpu_sched4_agg_toks"], 2)
         _emit(out, extras)
         return
     # absolute last resort: still print a parseable line

@@ -1,4 +1,5 @@
-"""`dllama` command-line app: inference | generate | chat | worker | batch.
+"""`dllama` command-line app: inference | generate | chat | worker |
+batch | router | serve-pod.
 
 Re-implements the reference app layer (`src/apps/dllama/dllama.cpp` +
 `src/app.cpp`) with the same flag surface (`AppArgs::parse`, app.cpp:19-93),
@@ -20,6 +21,12 @@ the reference's four modes (dllama.cpp:221-252) plus a beyond-reference
   (``--prompts-file``) as one lockstep ragged batch
   (Engine.generate_batch); aggregate tok/s scales with batch while the
   per-step cost stays near one stream's.
+* ``router``    — beyond reference: fleet router fronting N dllama-api
+  replicas (router/service.py; pure HTTP, no jax in-process).
+* ``serve-pod`` — beyond reference: partition the local devices into
+  ``--dp`` tensor-parallel serving replicas of ``--workers tpu:N``
+  chips each and front them with the fleet router on one public port
+  (router/pod.py).
 
 ``--workers`` keeps its name but takes ``tpu:N`` (a mesh degree) instead of
 host:port pairs — the transport is XLA collectives, not sockets.  ``--sp``/
@@ -53,7 +60,7 @@ DTYPES = {"f32": "float32", "bf16": "bfloat16", "f16": "float16"}
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dllama", description=__doc__)
     p.add_argument("mode", choices=["inference", "generate", "chat", "worker",
-                                    "batch", "router"])
+                                    "batch", "router", "serve-pod"])
     p.add_argument("--model", help="path to .m model file")
     p.add_argument("--tokenizer", help="path to .t tokenizer file")
     p.add_argument("--prompt", default=None)
@@ -410,6 +417,9 @@ def cmd_inference(args) -> None:
     # number from an XLA-dequant fallback must not read as a clean result
     from .obs import dispatch as obs_dispatch
     print(obs_dispatch.summary_line())
+    coll = obs_dispatch.collective_line()
+    if coll:
+        print(coll)
     _print_slo_summary(args)
     if engine.timing_mode == "host-fetch":
         # remote tunnel: the ready marker fires at dispatch, so I above is
@@ -518,6 +528,9 @@ def cmd_batch(args) -> None:
         print(f"Batched throughput:  {generated / dt:.2f} tok/s")
     from .obs import dispatch as obs_dispatch
     print(obs_dispatch.summary_line())
+    coll = obs_dispatch.collective_line()
+    if coll:
+        print(coll)
     _print_slo_summary(args)
 
 
@@ -612,6 +625,15 @@ def cmd_router(args) -> None:
     router_main(args)
 
 
+def cmd_serve_pod(args) -> None:
+    """Pod-slice serving: partition the local devices into ``--dp``
+    tensor-parallel replicas of ``--workers tpu:N`` chips each, serve
+    the OpenAI surface per replica, and front them with the fleet
+    router on ``--port`` (router/pod.py)."""
+    from .router.pod import main as pod_main
+    pod_main(args)
+
+
 # One table drives the --program choices AND the worker dispatch, so a
 # new mirrored program cannot be added to one and missed in the other
 # (chat stays out: interactive, single-host only).
@@ -640,7 +662,7 @@ def main(argv=None) -> None:
         init_distributed(args.coordinator, args.nproc, args.proc_id)
     {"inference": cmd_inference, "generate": cmd_generate,
      "chat": cmd_chat, "worker": cmd_worker, "batch": cmd_batch,
-     "router": cmd_router}[args.mode](args)
+     "router": cmd_router, "serve-pod": cmd_serve_pod}[args.mode](args)
 
 
 if __name__ == "__main__":

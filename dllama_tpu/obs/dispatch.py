@@ -117,6 +117,22 @@ def summary_line() -> str:
     return f"💡 kernel dispatch: clean; paths: {path_part}"
 
 
+def collective_line() -> str | None:
+    """End-of-run collective-overlap share: of the tp-sharded col-matmul
+    call sites this process compiled, how many took the fused RDMA ring
+    (transfer overlapped with accumulate) vs the plain-psum fallback.
+    None when no tp collective was dispatched at all (tp=1 runs stay
+    silent)."""
+    with _lock:
+        fused = _dispatches.get("q40/tp_fused_reduce", 0)
+        psum = _dispatches.get("q40/tp_psum", 0)
+    total = fused + psum
+    if not total:
+        return None
+    return (f"🔗 tp collectives: {fused}/{total} sharded matmul sites "
+            f"fused (overlap share {fused / total:.2f})")
+
+
 def reset() -> None:
     """Clear the ledger AND its registry counters (test isolation)."""
     global _degraded

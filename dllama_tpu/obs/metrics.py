@@ -512,6 +512,10 @@ ENGINE_INFERENCE_MS = REGISTRY.histogram(
 ENGINE_TRANSFER_MS = REGISTRY.histogram(
     "engine_transfer_ms", STEP_MS_BUCKETS,
     "Per-token host<->device boundary time (T), milliseconds.")
+ENGINE_COLLECTIVE_MS = REGISTRY.histogram(
+    "engine_collective_ms", STEP_MS_BUCKETS,
+    "Measured tp all-reduce latency of a decode-width partial sum "
+    "across the engine's mesh (Engine.probe_collective), milliseconds.")
 HOST_DEVICE_SENT_BYTES = REGISTRY.histogram(
     "host_device_sent_bytes", BYTES_BUCKETS,
     "Host->device bytes per engine dispatch (tokens + scalars).")

@@ -1,0 +1,28 @@
+"""Version portability for Pallas TPU compiler params.
+
+jax renamed ``pltpu.TPUCompilerParams`` to ``pltpu.CompilerParams`` and
+grew new fields (``has_side_effects``) along the way; pinning either
+spelling breaks half the installs we run on.  :func:`compiler_params`
+resolves whichever class the installed jax exports and drops kwargs the
+class predates, so kernels written against the new spelling still build
+on older jax.
+
+Dropped fields are harmless here by construction: every kernel in this
+package consumes its pallas_call outputs, so ``has_side_effects`` (DCE
+protection for output-free kernels) never changes lowering for us.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from jax.experimental.pallas import tpu as pltpu
+
+_CLS = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+_FIELDS = {f.name for f in dataclasses.fields(_CLS)}
+
+
+def compiler_params(**kw):
+    """Build the installed jax's Pallas TPU compiler-params object,
+    keeping only the fields this jax version knows about."""
+    return _CLS(**{k: v for k, v in kw.items() if k in _FIELDS})

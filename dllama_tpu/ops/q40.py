@@ -61,9 +61,10 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
+from . import pallas_compat
 from .. import quants
 from ..obs import dispatch as obs_dispatch
-from ..parallel.mesh import get_active_mesh
+from ..parallel.mesh import get_active_mesh, shard_map
 
 # Sweet spot measured on v5e (HBM-roofline for the 4096×11008 matvec);
 # shrunk automatically when N or D is smaller.  Env-overridable so
@@ -569,7 +570,7 @@ def _pallas_matmul(x: jax.Array, qpacked: jax.Array, scales: jax.Array,
         out_specs=pl.BlockSpec((t, tile_d), lambda j, i: (0, j), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
         scratch_shapes=[pltpu.VMEM((t, tile_d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compat.compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(x_lo, x_hi, bsum, qpacked, scales)
@@ -617,7 +618,7 @@ def _pallas_matmul_stacked(x: jax.Array, qpacked: jax.Array, scales: jax.Array,
             scratch_shapes=[pltpu.VMEM((t, tile_d), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compat.compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(layer.reshape(1).astype(jnp.int32), x_lo, x_hi, bsum, qpacked, scales)
@@ -872,7 +873,7 @@ def _pallas_matmul_blocked(x: jax.Array, qb: jax.Array, sb: jax.Array,
             scratch_shapes=[pltpu.VMEM((t, td), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((t, nJ * td), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compat.compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(layer.reshape(1).astype(jnp.int32), x_lo, x_hi, bsum, qb, sb)
@@ -903,6 +904,107 @@ def _tp_shardable(np_: int, d: int, kind: str | None, tp: int) -> bool:
     return False
 
 
+def _fused_reduce_ok(d: int, tp: int, interp: bool) -> bool:
+    """Can the bidirectional ring reduce replace the trailing psum?
+
+    TPU-only (the kernel is built on inter-chip RDMA,
+    ``pltpu.make_async_remote_copy``); both direction halves must be
+    lane-aligned so the comm buffers tile cleanly; ``DLLAMA_TP_REDUCE=psum``
+    is the operator's portable opt-out (a requested path, not a degrade)."""
+    if interp or tp < 2:
+        return False
+    if os.environ.get("DLLAMA_TP_REDUCE", "") == "psum":
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    return d % (2 * 128) == 0
+
+
+def _ring_reduce_kernel(x_ref, o_ref, comm_ref, send_sem, recv_sem, *,
+                        tp: int):
+    """Bidirectional ring all-reduce of a (t, d) f32 partial sum over
+    ``tp``.
+
+    The output half ``[:, :d/2]`` circulates clockwise (to the right
+    neighbor), the half ``[:, d/2:]`` counter-clockwise — both ICI
+    directions carry traffic every step, so the reduce finishes in
+    ``tp-1`` steps of ``d/2`` words instead of ``tp-1`` steps of ``d``.
+    Each step's accumulate folds the chunk received the PREVIOUS step
+    while the current transfer is in flight: the VPU add hides under the
+    RDMA, which is the "reduce fused into the dispatch" this kernel
+    exists for (the psum it replaces serializes transfer after the
+    matmul).
+    """
+    t, d = x_ref.shape
+    dh = d // 2
+    my = jax.lax.axis_index("tp")
+    right = jax.lax.rem(my + 1, tp)
+    left = jax.lax.rem(my + tp - 1, tp)
+    # the serving mesh is (dp, sp, ep, tp) with tp innermost; a neighbor
+    # differs only in the tp coordinate
+    base = (jax.lax.axis_index("dp"), jax.lax.axis_index("sp"),
+            jax.lax.axis_index("ep"))
+
+    # accumulator starts at the local partial; each direction's slot-0
+    # payload is the local half that will circulate that way
+    o_ref[...] = x_ref[...]
+    comm_ref[0, 0] = x_ref[:, :dh]
+    comm_ref[1, 0] = x_ref[:, dh:]
+
+    # neighbor barrier: no RDMA may land in a peer still seeding its
+    # comm buffers (guide: Local Barrier Between Neighbors)
+    barrier = pltpu.get_barrier_semaphore()
+    for nb in (right, left):
+        pltpu.semaphore_signal(barrier, inc=1, device_id=base + (nb,),
+                               device_id_type=pltpu.DeviceIdType.MESH)
+    pltpu.semaphore_wait(barrier, 2)
+
+    for step in range(tp - 1):
+        snd, rcv = step % 2, (step + 1) % 2
+        copies = []
+        for dirn, nb in ((0, right), (1, left)):
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=comm_ref.at[dirn, snd],
+                dst_ref=comm_ref.at[dirn, rcv],
+                send_sem=send_sem.at[dirn, snd],
+                recv_sem=recv_sem.at[dirn, rcv],
+                device_id=base + (nb,),
+                device_id_type=pltpu.DeviceIdType.MESH)
+            rdma.start()
+            copies.append(rdma)
+        if step > 0:
+            # overlap: fold the chunk received last step (slot ``snd`` —
+            # also this step's outgoing payload; both are reads) into the
+            # accumulator while the transfer is in flight
+            o_ref[:, :dh] += comm_ref[0, snd]
+            o_ref[:, dh:] += comm_ref[1, snd]
+        for rdma in copies:
+            rdma.wait()
+    last = (tp - 1) % 2
+    o_ref[:, :dh] += comm_ref[0, last]
+    o_ref[:, dh:] += comm_ref[1, last]
+
+
+def _tp_ring_allreduce(x: jax.Array, tp: int) -> jax.Array:
+    """All-reduce ``x`` (t, d) f32 over the ``tp`` axis with the
+    bidirectional RDMA ring — called inside the ``_sharded_matmul``
+    shard_map body, immediately after the per-shard matmul kernel."""
+    t, d = x.shape
+    return pl.pallas_call(
+        functools.partial(_ring_reduce_kernel, tp=tp),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((2, 2, t, d // 2), jnp.float32),  # [dir, slot, ...]
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+        compiler_params=pallas_compat.compiler_params(
+            has_side_effects=True, collective_id=0),
+    )(x)
+
+
 def _sharded_matmul(x2: jax.Array, qp: jax.Array, s: jax.Array,
                     layer: jax.Array | None,
                     kind: str, mesh, interp: bool) -> jax.Array:
@@ -913,28 +1015,50 @@ def _sharded_matmul(x2: jax.Array, qp: jax.Array, s: jax.Array,
     communication, matching RowMatmulSlice (commands.cpp:8-40).
 
     ``kind="col"``: weight input dim sharded — each shard contracts its
-    input slice into a full-width partial sum, combined with one ``psum``
-    over ``tp`` (ColMatmulSlice + the root merge, commands.cpp:42-70,
-    llama2-tasks.cpp:125-131).  The pack-time padding sits at the global
-    end of the input axis, so activation columns and packed rows shard at
-    the same logical boundaries.
+    input slice into a full-width partial sum, combined over ``tp``
+    (ColMatmulSlice + the root merge, commands.cpp:42-70,
+    llama2-tasks.cpp:125-131).  On TPU the combine is the bidirectional
+    RDMA ring (:func:`_tp_ring_allreduce`) fused into the dispatch —
+    partial-sum transfer overlaps the accumulate — with ``jax.lax.psum``
+    kept as the portable fallback; the choice is recorded in the
+    dispatch ledger (``path=tp_fused_reduce|tp_psum``).  The pack-time
+    padding sits at the global end of the input axis, so activation
+    columns and packed rows shard at the same logical boundaries.
 
     Axes other than ``tp`` (``dp``/``sp``) are unmentioned in the specs:
     shard_map treats the operands as replicated across them, which is
     exactly the activations' layout in this framework.
     """
     stacked = layer is not None
-    if mesh.shape.get("tp", 1) == 1 or kind == "row":
+    tp = mesh.shape.get("tp", 1)
+    fused = False
+    if tp == 1 or kind == "row":
         # tp==1 (sp/dp-only mesh): fully replicated specs — each device runs
         # the whole kernel; shard_map only exists to keep GSPMD from trying
         # (and failing) to partition the pallas_call
-        tp_ax = "tp" if kind in ("row", "col") and mesh.shape.get("tp", 1) > 1 else None
+        tp_ax = "tp" if kind in ("row", "col") and tp > 1 else None
         wspec = P(None, None, tp_ax) if stacked else P(None, tp_ax)
         xspec, ospec = P(None, None), P(None, tp_ax)
         kind = "row" if tp_ax else "repl"
     else:
         wspec = P(None, "tp", None) if stacked else P("tp", None)
         xspec, ospec = P(None, "tp"), P(None, None)
+        d_out = qp.shape[-1]
+        fused = _fused_reduce_ok(d_out, tp, interp)
+        obs_dispatch.record_dispatch(
+            "q40", "tp_fused_reduce" if fused else "tp_psum",
+            kind="col", tp=tp, d=d_out)
+        if not fused and not interp \
+                and os.environ.get("DLLAMA_TP_REDUCE", "") != "psum":
+            # falling off the fused collective is a degrade off the fast
+            # path, same funnel as blocked_ignored_mesh (warn-once per
+            # backend + width; the counter keeps the true count)
+            obs_dispatch.record_degrade(
+                "q40", "tp_psum",
+                warn_key=(jax.default_backend(), d_out),
+                backend=jax.default_backend(), tp=tp, d=d_out,
+                hint="fused ring reduce needs a TPU backend and "
+                     "d % 256 == 0; decode collectives run as plain psum")
 
     def body(x_local, qp, s, *l):
         if stacked:
@@ -942,12 +1066,15 @@ def _sharded_matmul(x2: jax.Array, qp: jax.Array, s: jax.Array,
         else:
             out = _pallas_matmul(x_local, qp, s, interpret=interp)
         if kind == "col":
-            out = jax.lax.psum(out, "tp")
+            if fused:
+                out = _tp_ring_allreduce(out, tp)
+            else:
+                out = jax.lax.psum(out, "tp")
         return out
 
     args = [x2, qp, s] + ([layer] if stacked else [])
     in_specs = [xspec, wspec, wspec] + ([P()] if stacked else [])
-    return jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+    return shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
                          out_specs=ospec, check_vma=False)(*args)
 
 
@@ -1011,7 +1138,7 @@ def _sharded_matmul_ep(x2: jax.Array, qp4: jax.Array, s4: jax.Array,
         out = jax.lax.cond(owned, run_kernel, skip, None)
         return jax.lax.psum(out, sum_axes)
 
-    return jax.shard_map(body, mesh=mesh,
+    return shard_map(body, mesh=mesh,
                          in_specs=(xspec, wspec, wspec, P()),
                          out_specs=ospec, check_vma=False)(x2, qp4, s4, flat_idx)
 

@@ -39,6 +39,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import pallas_compat
 from .. import quants
 from .q40 import (PALLAS_MAX_ROWS, QLayerView, _f16_bits_to_f32, _pad_x,
                   _smap_mesh, _tiles, padded_n)
@@ -242,7 +243,7 @@ def _pallas_matmul(x: jax.Array, qv: jax.Array, s: jax.Array,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
         scratch_shapes=[pltpu.VMEM((t, tile_d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compat.compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(x.astype(jnp.bfloat16), qv, s)
@@ -271,7 +272,7 @@ def _pallas_matmul_stacked(x: jax.Array, qv: jax.Array, s: jax.Array,
             scratch_shapes=[pltpu.VMEM((t, tile_d), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compat.compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(layer.reshape(1).astype(jnp.int32), x.astype(jnp.bfloat16), qv, s)

@@ -34,20 +34,34 @@ from ..models.config import ModelConfig
 REPL = P()
 
 
+def valid_tp_degrees(cfg: ModelConfig) -> list[int]:
+    """Every tensor-parallel degree this model accepts: divisors of both
+    head counts and of hidden_dim, capped at nKvHeads (a shard owns whole
+    KV heads, so no degree past that can be legal)."""
+    return [d for d in range(1, cfg.n_kv_heads + 1)
+            if cfg.n_heads % d == 0 and cfg.n_kv_heads % d == 0
+            and cfg.hidden_dim % d == 0]
+
+
 def check_tp_constraint(cfg: ModelConfig, tp: int) -> None:
     """Reference parity: cannot split across more nodes than KV heads
     (transformer.cpp:88-91).  Head counts must divide evenly because a
     shard owns whole heads (MultiHeadAttSlice asserts nHeads % nSlices == 0,
-    commands.cpp:101-105)."""
+    commands.cpp:101-105).  Every rejection names the degrees that WOULD
+    work, so the operator's next command can be right, not just different."""
+    valid = valid_tp_degrees(cfg)
+    hint = f"valid tp degrees for this model: {valid}"
     if tp > cfg.n_kv_heads:
         raise ValueError(
             f"tensor-parallel degree {tp} exceeds nKvHeads={cfg.n_kv_heads} "
             "(reference: 'This version does not support more nodes than the "
-            "number of KV heads', transformer.cpp:88-91)")
+            f"number of KV heads', transformer.cpp:88-91); {hint}")
     if cfg.n_heads % tp or cfg.n_kv_heads % tp:
-        raise ValueError(f"head counts ({cfg.n_heads}/{cfg.n_kv_heads}) not divisible by tp={tp}")
+        raise ValueError(f"head counts ({cfg.n_heads}/{cfg.n_kv_heads}) not "
+                         f"divisible by tp={tp}; {hint}")
     if cfg.hidden_dim % tp:
-        raise ValueError(f"hidden_dim {cfg.hidden_dim} not divisible by tp={tp}")
+        raise ValueError(f"hidden_dim {cfg.hidden_dim} not divisible by "
+                         f"tp={tp}; {hint}")
 
 
 def param_specs(cfg: ModelConfig) -> dict[str, P]:

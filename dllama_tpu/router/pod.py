@@ -1,0 +1,200 @@
+"""``dllama serve-pod``: dp × tp engine replicas in one process, fronted
+by the fleet router on one public port.
+
+The single-replica serving story shards one engine over every local
+device.  On a pod slice that wastes the topology: decode is
+latency-bound per request, so past the tp degree that saturates ICI
+bandwidth, extra chips buy more *replicas*, not faster tokens.  This
+mode partitions the local devices into ``--dp`` independent replicas of
+``--workers tpu:N`` chips each:
+
+* the model is read from disk ONCE (host-side), then placed per replica
+  mesh — no N× disk traffic for N replicas;
+* every replica runs the full serving stack — OpenAI surface, slot
+  scheduler, paged KV, hand-off — on its own loopback port (ephemeral,
+  never a collision), exactly the process a standalone ``dllama-api``
+  would be;
+* the fleet router (:mod:`.registry` + :mod:`.service`) starts in the
+  same process with the replicas auto-registered as backends, so the
+  operator sees ONE address and the usual probe/eject/score dispatch.
+
+``SIGTERM`` drains through the router path like any fleet: the router
+stops, then each replica's server shuts down.  Cross-replica request
+migration (DLREQ01) keeps working because the replicas expose the same
+``/admin/export``/``/admin/import`` surface as external backends.
+"""
+
+from __future__ import annotations
+
+from ..obs.log import get_logger
+
+_log = get_logger("router.pod")
+
+
+def parse_pod_tp(workers: str | None, n_devices: int, dp: int) -> int:
+    """Per-replica tp degree: ``--workers tpu:N`` names it explicitly;
+    default splits every local device evenly over the dp replicas."""
+    if workers is None:
+        tp, rem = divmod(n_devices, dp)
+        if tp < 1:
+            raise SystemExit(
+                f"serve-pod: {dp} replicas need at least {dp} of the "
+                f"{n_devices} local devices")
+        return tp
+    w = workers.strip().lower()
+    if not w.startswith("tpu:"):
+        raise SystemExit(f"serve-pod: --workers takes tpu:N, got {workers!r}")
+    try:
+        tp = int(w.split(":", 1)[1])
+    except ValueError:
+        raise SystemExit(f"serve-pod: --workers takes tpu:N, got {workers!r}")
+    if tp < 1:
+        raise SystemExit(f"serve-pod: tp degree must be >= 1, got {tp}")
+    return tp
+
+
+def partition_devices(devices, dp: int, tp: int) -> list[list]:
+    """dp disjoint tp-sized device groups, contiguous in enumeration
+    order (tp innermost keeps each replica's collectives on the
+    fastest links, matching make_mesh's axis order)."""
+    need = dp * tp
+    if need > len(devices):
+        raise SystemExit(
+            f"serve-pod: dp={dp} × tp={tp} needs {need} devices, "
+            f"only {len(devices)} present")
+    if need < len(devices):
+        _log.warning("pod_devices_idle", extra={
+            "used": need, "present": len(devices)})
+    return [list(devices[r * tp:(r + 1) * tp]) for r in range(dp)]
+
+
+def main(args) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from .. import quants
+    from ..cli import DTYPES
+    from ..io import mfile, tfile
+    from ..models.config import ModelConfig
+    from ..models.params import load_params
+    from ..obs import dispatch as obs_dispatch
+    from ..parallel.mesh import make_mesh
+    from ..parallel.sharding import check_tp_constraint
+    from ..runtime.engine import Engine
+    from ..runtime.scheduler import SlotScheduler
+    from ..server import api
+    from ..tokenizer.bpe import Tokenizer
+    from .registry import Registry
+    from .service import RouterState
+    from .service import serve as router_serve
+
+    if not args.model or not args.tokenizer:
+        raise SystemExit("--model and --tokenizer are required for serve-pod")
+    if args.sp > 1 or args.ep > 1:
+        raise SystemExit("serve-pod partitions devices into dp × tp "
+                         "replicas; --sp/--ep are not supported here "
+                         "(run a single replica with dllama-api instead)")
+    devices = jax.devices()
+    dp = max(args.dp, 1)
+    tp = parse_pod_tp(args.workers, len(devices), dp)
+    groups = partition_devices(devices, dp, tp)
+
+    wft = quants.FLOAT_TYPE_BY_NAME[args.weights_float_type] \
+        if args.weights_float_type else None
+    mf = mfile.MFile(args.model, weights_ftype=wft,
+                     verify=getattr(args, "verify_weights", False))
+    bft = "bf16" if args.buffer_float_type == "q80" else args.buffer_float_type
+    dtype = jnp.dtype(DTYPES[bft])
+    cfg = ModelConfig.from_spec(mf.spec, dtype=dtype)
+    # fail before the (minutes-long) weight load, with the valid-degrees
+    # hint naming the tp that WOULD work
+    check_tp_constraint(cfg, tp)
+    cfg, params = load_params(mf, cfg, dtype=dtype,
+                              keep_quantized=not args.dequantize,
+                              fuse=tp == 1)
+    tok = Tokenizer(tfile.read_tfile(args.tokenizer))
+    if tok.vocab_size != cfg.vocab_size:
+        raise SystemExit("tokenizer is incompatible with model "
+                         "(vocab size mismatch)")
+    kv_dtype = ("q8" if args.kv_cache_dtype == "q8"
+                else jnp.dtype(DTYPES[args.kv_cache_dtype])
+                if args.kv_cache_dtype else None)
+
+    replicas: list[tuple[str, object, SlotScheduler | None]] = []
+    try:
+        for r, devs in enumerate(groups):
+            mesh = make_mesh(tp=tp, devices=devs)
+            engine = Engine(cfg, params, mesh=mesh, seq_len=args.max_seq_len,
+                            kv_dtype=kv_dtype, batch=1,
+                            step_timeout=getattr(args, "step_timeout", None),
+                            numeric_checks=(True if getattr(
+                                args, "numeric_checks", False) else None))
+            batch_engine = None
+            scheduler = None
+            if args.batch_slots > 0:
+                if args.kv_pages > 0 and engine.cache.quantized:
+                    raise SystemExit("--kv-pages needs a dense KV cache; "
+                                     "drop --kv-cache-dtype q8")
+                batch_engine = Engine(
+                    engine.cfg, engine.params, mesh=mesh,
+                    batch=args.batch_slots, seq_len=args.max_seq_len,
+                    kv_dtype=engine.cache.k.dtype,
+                    step_timeout=getattr(args, "step_timeout", None),
+                    kv_pages=args.kv_pages, kv_page_size=args.kv_page_size)
+                try:
+                    scheduler = SlotScheduler(
+                        batch_engine,
+                        prefill_chunk=args.sched_prefill_chunk,
+                        max_wait_ms=args.sched_max_wait_ms,
+                        max_queue=args.sched_max_queue,
+                        prefix_reuse=not args.no_prefix_reuse,
+                        overlap=not args.no_sched_overlap,
+                        preempt=not args.no_preempt,
+                        preempt_age_ms=args.preempt_age_ms,
+                        preempt_cap=args.preempt_cap,
+                        spill_dir=args.preempt_spill_dir)
+                except ValueError as e:
+                    _log.warning("slot_scheduler_disabled",
+                                 extra={"replica": r, "reason": str(e)})
+            state = api.ApiState(
+                engine, tok, default_temperature=args.temperature,
+                default_topp=args.topp, chunk=args.chunk,
+                batch_engine=batch_engine, max_pending=args.max_pending,
+                request_timeout=args.request_timeout,
+                io_timeout=args.io_timeout, drain_grace=args.drain_grace,
+                scheduler=scheduler,
+                handoff=getattr(args, "handoff", False))
+            # loopback + ephemeral port: the OS picks, so dp replicas can
+            # never collide with each other or the public port
+            server = api.serve(state, host="127.0.0.1", port=0,
+                               block=False, install_signals=False)
+            addr = "127.0.0.1:%d" % server.server_address[1]
+            replicas.append((addr, server, scheduler))
+            _log.info("pod_replica_up", extra={
+                "replica": r, "tp": tp, "addr": addr,
+                "devices": [str(d) for d in devs]})
+
+        registry = Registry(
+            [a for a, _, _ in replicas],
+            probe_interval=args.probe_interval,
+            eject_after=args.eject_after,
+            readmit_after=args.readmit_after,
+            probe_timeout=min(float(args.upstream_timeout), 5.0))
+        rstate = RouterState(registry, retries=args.router_retries,
+                             upstream_timeout=args.upstream_timeout)
+        print(f"💡 serve-pod: {dp} replica(s) × tp={tp} over "
+              f"{dp * tp}/{len(devices)} devices; router on :{args.port}")
+        router_serve(rstate, host=args.host, port=args.port)
+    finally:
+        for _, server, scheduler in replicas:
+            try:
+                server.shutdown()
+                server.server_close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+            if scheduler is not None:
+                scheduler.close()
+        print(obs_dispatch.summary_line())
+        coll = obs_dispatch.collective_line()
+        if coll:
+            print(coll)

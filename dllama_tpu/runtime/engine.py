@@ -37,7 +37,7 @@ from ..obs import dispatch as obs_dispatch, metrics as obs_metrics, \
     trace as obs_trace
 from ..obs.log import get_logger
 from ..parallel import sharding
-from ..parallel.mesh import active_mesh, make_mesh
+from ..parallel.mesh import active_mesh, make_mesh, shard_map
 from ..sampling import Sampler
 
 _log = get_logger("runtime.engine")
@@ -290,6 +290,22 @@ class Engine:
                     mesh_size=self.mesh.size,
                     hint="blocked storage is single-device only; "
                          "row-major keeps sharding semantics")
+        if self.mesh.shape.get("tp", 1) > 1 \
+                and jax.default_backend() != "tpu" \
+                and os.environ.get("DLLAMA_TP_REDUCE", "") != "psum":
+            # tp serving off-TPU cannot take the fused collective-matmul
+            # decode path (ops/q40.py _tp_ring_allreduce is built on
+            # inter-chip RDMA): decode collectives degrade to plain
+            # psum/GSPMD all-reduce.  Same ledger treatment as
+            # blocked_ignored_mesh — the run still serves, but a bench
+            # number from this configuration must not read as the fused
+            # number
+            obs_dispatch.record_degrade(
+                "q40", "tp_psum", warn_key=jax.default_backend(),
+                backend=jax.default_backend(),
+                tp=self.mesh.shape.get("tp", 1),
+                hint="fused collective-matmul decode is TPU-only; tp "
+                     "collectives run as plain psum all-reduce")
         self.params = sharding.place_params(params, cfg, self.mesh)
         # kv_dtype "q8" (or int8) selects the quantized cache: int8 values
         # + per-position f32 scales — ~2× less cache HBM traffic and
@@ -377,6 +393,10 @@ class Engine:
         self._compiled_steps: set = set()
         self._key = jax.random.PRNGKey(0)
         self._chunk_counter = 0
+        # collective-latency probe (probe_collective): compiled lazily on
+        # first use, rate-limited host-side
+        self._collective_fn = None
+        self._collective_probe_t = 0.0
         self._offsets: jax.Array | None = None  # ragged-batch left padding
 
     # ------------------------------------------------------------------
@@ -538,6 +558,42 @@ class Engine:
         exporting replica's draw sequence instead of this process's)."""
         self._key = jnp.asarray(key_np)
         self._chunk_counter = int(chunk_counter)
+
+    def probe_collective(self, min_interval_s: float = 0.5) -> float | None:
+        """Time one tp all-reduce of a decode-width (1, dim) partial sum
+        across this engine's mesh and feed ``engine_collective_ms``.
+
+        The in-step collective (the fused ring or its psum fallback) is
+        fused inside a compiled program, so its latency is not separable
+        host-side; this probe dispatches the same-shape reduce as its own
+        program — real devices, real ICI path — which is the per-step
+        collective cost the fused-reduce work targets.  Rate-limited
+        (callers may invoke per burst), no-op on tp==1 meshes; the first
+        call compiles outside the timed window.  Returns the measured
+        milliseconds, or None when skipped."""
+        tp = self.mesh.shape.get("tp", 1)
+        if tp <= 1:
+            return None
+        now = time.monotonic()
+        if now - self._collective_probe_t < min_interval_s:
+            return None
+        if self._collective_fn is None:
+            fn = jax.jit(shard_map(
+                lambda v: jax.lax.psum(v, "tp"), mesh=self.mesh,
+                in_specs=P(None, "tp"), out_specs=P(None, None),
+                check_vma=False))
+            x = jax.device_put(
+                jnp.zeros((1, self.cfg.dim), jnp.float32),
+                NamedSharding(self.mesh, P(None, "tp")))
+            jax.block_until_ready(fn(x))  # compile, uncounted
+            self._collective_fn = (fn, x)
+        fn, x = self._collective_fn
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(x))
+        ms = (time.perf_counter() - t0) * 1e3
+        self._collective_probe_t = now
+        obs_metrics.ENGINE_COLLECTIVE_MS.observe(ms)
+        return ms
 
     def read_pool_pages(self, pages) -> dict[str, np.ndarray]:
         """Copy the given physical pages out of the paged pool to host

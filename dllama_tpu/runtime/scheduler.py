@@ -1478,6 +1478,13 @@ class SlotScheduler:
                     self._retire(i, "error", error=error)
             return False
         self._note_step_time(wall_ms, cur.steps, cur.handle.fresh)
+        if self.engine.mesh.shape.get("tp", 1) > 1:
+            # sample the mesh's all-reduce latency alongside real decode
+            # traffic (rate-limited inside probe_collective) so the
+            # engine_collective_ms histogram reflects the serving mesh
+            # under load, not an idle microbenchmark
+            with self._engine_lock:
+                self.engine.probe_collective()
         obs_trace.record("sched_step", cur.t0_mono, time.monotonic(),
                          active=n_act, queued=cur.queued,
                          t=cur.t_width, steps=cur.steps,

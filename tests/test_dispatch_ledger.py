@@ -182,6 +182,46 @@ def test_dispatch_paths_recorded():
     assert "q40/xla-dequant" in obs_dispatch.summary_line()
 
 
+def test_engine_init_degrades_share_ledger_treatment(monkeypatch):
+    """The two engine-construction degrades — blocked layout silently
+    kept row-major on a mesh (``blocked_ignored_mesh``), and off-TPU tp
+    collectives falling back to plain psum (``tp_psum``) — take the
+    identical ledger path: labeled counter + degraded flag + warn-once
+    structured record, never scrollback."""
+    import jax
+    from dllama_tpu.models.config import tiny_config
+    from dllama_tpu.models.params import init_params
+    from dllama_tpu.parallel.mesh import make_mesh
+    from dllama_tpu.runtime.engine import Engine
+
+    cfg = tiny_config()
+    records = []
+    h = logging.Handler()
+    h.emit = lambda r: records.append(r)
+    lg = logging.getLogger("dllama.obs.dispatch")
+    lg.addHandler(h)
+    old = lg.level
+    lg.setLevel(logging.DEBUG)
+    try:
+        monkeypatch.setenv("DLLAMA_Q40_LAYOUT", "blocked")
+        # one tp=2 engine on CPU trips both: blocked storage is ignored
+        # on any mesh, and tp collectives have no RDMA ring off-TPU
+        for _ in range(2):
+            Engine(cfg, init_params(cfg, seed=4),
+                   mesh=make_mesh(tp=2, devices=jax.devices()[:2]))
+    finally:
+        lg.removeHandler(h)
+        lg.setLevel(old)
+    assert obs_dispatch.degraded() is True
+    for reason in ("blocked_ignored_mesh", "tp_psum"):
+        assert obs_metrics.Q40_DEGRADE.get(reason) == 2, reason
+        assert obs_dispatch.reasons().get(f"q40:{reason}") == 2, reason
+    warned = [r.__dict__["reason"] for r in records
+              if r.getMessage() == "kernel_degrade"]
+    assert sorted(warned) == ["blocked_ignored_mesh", "tp_psum"], \
+        "one structured record per degrade site, not per engine"
+
+
 # --- tentpole: engine compile telemetry -----------------------------------
 
 @pytest.fixture(scope="module")

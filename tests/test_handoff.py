@@ -210,3 +210,55 @@ def test_export_fails_queued_tickets_without_records(stack):
     queued = {t.rid for t in tickets} - admitted
     assert len(queued) == 1
     assert set(records) == admitted
+
+
+def test_tp4_export_tp1_import_byte_parity():
+    """Cross-geometry hand-off: a record exported from a tp=4 sharded
+    paged scheduler imports into a tp=1 replica and resumes
+    byte-identically.  The hand-off fingerprint digests *global* cache
+    geometry (page size, heads, head dim), never the mesh shape — a
+    pod-slice replica draining into a single-chip spare is exactly the
+    rolling-restart path the fleet router exercises."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    cfg = tiny_config(hidden_dim=128, n_kv_heads=4, seq_len=64)
+
+    def paged(tp):
+        pages_per_slot = -(-cfg.seq_len // PAGE)
+        return Engine(cfg, init_params(cfg, seed=4),
+                      mesh=make_mesh(tp=tp, devices=jax.devices()[:tp]),
+                      batch=2, kv_pages=2 * pages_per_slot + 1,
+                      kv_page_size=PAGE)
+
+    solo = Engine(cfg, init_params(cfg, seed=4),
+                  mesh=make_mesh(tp=1, devices=jax.devices()[:1]), batch=1)
+    toks = [t for t, _ in solo.generate_stream(
+        P, len(P) + 30, temperature=0.0, chunk=5)]
+    solo_ref = toks[len(P):]
+
+    sa = SlotScheduler(paged(4), prefill_chunk=4, max_wait_ms=20.0,
+                       decode_burst=4)
+    sb = SlotScheduler(paged(1), prefill_chunk=4, max_wait_ms=20.0,
+                       decode_burst=4)
+    try:
+        assert sa.engine.handoff_fingerprint() == \
+            sb.engine.handoff_fingerprint(), \
+            "mesh shape must not be part of replica identity"
+        with injected("engine.device_step=delay:0.05"):
+            t = sa.submit(P, 30, temperature=0.0)
+            it = t.tokens()
+            for _ in range(6):
+                next(it)
+            records = sa.handoff_export_all()
+        list(it)
+        assert t.finish == "handoff"
+        meta, _ = snapfmt.loads_request(records[t.rid])
+        replayed = [int(x) for x in meta["extra"]["completion"]]
+        t2, _ = sb.import_request(records[t.rid])
+        resumed = list(t2.tokens())
+        assert t2.finish == "length"
+        assert replayed + resumed == solo_ref, \
+            "tp=4 export → tp=1 import drifted"
+    finally:
+        sa.close()
+        sb.close()
