@@ -691,6 +691,75 @@ def _bench_sched_overlap(cfg, slots=4, max_new=96):
     return {"sync": run_mode(False), "overlap": run_mode(True)}
 
 
+def _bench_sched_spec(cfg, slots=4, max_new=96, spec_k=4):
+    """Speculative-decoding A/B (runtime/spec.py + the slot-verify
+    dispatch): the ``-sched4`` staggered workload run twice, speculation
+    off then on with the prompt-lookup proposer.  Greedy output is
+    byte-identical in both modes (the emitted stream is always the
+    model's own argmax); the tok/s delta is what the verify window's
+    multi-token yield buys when drafts are accepted.  Returns a dict
+    with tok/s per mode plus the cumulative accept ratio."""
+    import threading
+
+    import jax
+    import numpy as np
+    from dllama_tpu.parallel.mesh import make_mesh
+    from dllama_tpu.runtime.engine import Engine
+    from dllama_tpu.runtime.scheduler import SlotScheduler
+    from dllama_tpu.runtime.spec import PromptLookupProposer
+
+    params = maybe_blocked(_zero_q40_params(cfg))
+    rng = np.random.RandomState(7)
+    prompts = [[int(t) for t in rng.randint(1, cfg.vocab_size, 8 + 4 * i)]
+               for i in range(slots)]
+
+    def run_mode(spec_on):
+        eng = Engine(cfg, params,
+                     mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+                     batch=slots)
+        spec = PromptLookupProposer(vocab=cfg.vocab_size) if spec_on else None
+        sched = SlotScheduler(eng, prefill_chunk=16, max_wait_ms=20.0,
+                              spec=spec, spec_k=spec_k)
+        counts = [0] * slots
+
+        def run(i, delay):
+            time.sleep(delay)
+            t = sched.submit(prompts[i], max_new)
+            counts[i] = sum(1 for _ in t.tokens())
+
+        def wave(stagger):
+            ths = [threading.Thread(target=run, args=(i, stagger * i))
+                   for i in range(slots)]
+            t0 = time.perf_counter()
+            for th in ths:
+                th.start()
+            for th in ths:
+                th.join()
+            return time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        wave(0.05)  # compile + warmup: same stagger, so the same shape set
+        print(f"compile+warmup (spec {'pld' if spec_on else 'off'}): "
+              f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+        elapsed = wave(0.05)
+        proposed = sched._spec_proposed
+        accepted = sched._spec_accepted
+        sched.close()
+        mode = {
+            "toks": sum(counts) / elapsed,
+            "accept_ratio": accepted / proposed if proposed else None,
+            "proposed": proposed, "accepted": accepted,
+        }
+        ratio = (f"{mode['accept_ratio']:.3f}"
+                 if mode["accept_ratio"] is not None else "n/a")
+        print(f"bench: sched-spec {'pld' if spec_on else 'off'}: "
+              f"{mode['toks']:.1f} tok/s, accept ratio {ratio} "
+              f"({accepted}/{proposed} drafts)", file=sys.stderr)
+        return mode
+
+    return {"off": run_mode(False), "spec": run_mode(True)}
+
+
 def run_attempt(name):
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     # bench children log like the server does (DLLAMA_LOG honored); all
@@ -760,6 +829,40 @@ def run_attempt(name):
             if base == "llama2-7b" else None,
             "collective_ms_avg": round(coll.sum / coll.count, 3)
             if coll.count else None,
+            "backend": jax.default_backend()}))
+        return
+
+    if name.endswith("-spec4"):
+        # speculative decoding (runtime/spec.py): the -sched4 staggered
+        # workload with the prompt-lookup proposer off vs on — the accept
+        # ratio says how often drafts verified, the tok/s delta what the
+        # multi-token verify yield bought.  Checked before -sched4 with
+        # the other sched-suffix stages.
+        base = name[:-6]
+        cfg = _model_cfg(base)
+        if base == "cpu-tiny":
+            impl = "xla"
+        else:
+            print(f"bench: {base}: claiming backend...", file=sys.stderr)
+            print(f"bench: {base}: backend {jax.default_backend()}",
+                  file=sys.stderr)
+            impl = _pallas_hw_check("q40")
+        ab = _bench_sched_spec(cfg.with_(quant_impl=impl))
+        on, off = ab["spec"], ab["off"]
+        print(json.dumps({
+            "metric": f"{base} q40 speculative-decoding slots=4 aggregate "
+                      f"decode tok/s (prompt-lookup drafts, spec_k=4, "
+                      f"{impl})",
+            "value": round(on["toks"], 2), "unit": "tok/s",
+            "vs_baseline": round(on["toks"] / BASELINE_7B_TOKS, 2)
+            if base == "llama2-7b" else None,
+            "spec_off_toks": round(off["toks"], 2),
+            "spec_speedup": round(on["toks"] / off["toks"], 3)
+            if off["toks"] else None,
+            "accept_ratio": round(on["accept_ratio"], 3)
+            if on["accept_ratio"] is not None else None,
+            "drafts_proposed": on["proposed"],
+            "drafts_accepted": on["accepted"],
             "backend": jax.default_backend()}))
         return
 
@@ -1348,6 +1451,20 @@ def main():
                     ov_out.get("host_gap_share_off")
                 print(f"bench: overlapped dispatch: {json.dumps(ov_out)}",
                       file=sys.stderr)
+        # speculative-decoding evidence: the sched4 workload with
+        # prompt-lookup drafts off vs on — on hardware each accepted
+        # draft saves a whole dispatch round trip, so the accept ratio
+        # converts directly into aggregate tok/s
+        if got_7b and remaining() > RESERVE + 280 and _relay_up():
+            sp_out = _spawn("llama2-7b-spec4", 300)
+            if sp_out:
+                extras["llama2-7b_spec4_agg_toks"] = sp_out["value"]
+                extras["llama2-7b_spec4_accept_ratio"] = \
+                    sp_out.get("accept_ratio")
+                extras["llama2-7b_spec4_speedup"] = \
+                    sp_out.get("spec_speedup")
+                print(f"bench: speculative decoding: {json.dumps(sp_out)}",
+                      file=sys.stderr)
         # prefix-sharing evidence: the sched4 workload with a shared
         # 128-token system prompt over the paged pool + radix cache — the
         # delta vs the sched4 row is the prefill the tree avoided
@@ -1514,6 +1631,20 @@ def main():
                 extras["cpu_sched4_agg_toks"] = sc["value"]
                 extras["cpu_sched4_vs_single"] = round(
                     sc["value"] / out["value"], 2)
+        if remaining() > 140:
+            # speculative decoding on the same CPU backend: the sched4
+            # workload with prompt-lookup drafts off vs on — the accept
+            # ratio is the real signal here (CPU step cost barely
+            # changes with window width, so tok/s parity is expected)
+            sp = _spawn("cpu-tiny-spec4", min(remaining() - 60, 300),
+                        env_extra=cpu_env)
+            if sp and sp.get("value"):
+                extras = extras or {}
+                extras["cpu_spec4_agg_toks"] = sp["value"]
+                extras["cpu_spec4_accept_ratio"] = sp.get("accept_ratio")
+                if sp.get("spec_off_toks"):
+                    extras["cpu_spec4_vs_sched4"] = round(
+                        sp["value"] / sp["spec_off_toks"], 2)
         if remaining() > 140:
             # paged KV + radix prefix sharing on the same CPU backend:
             # the sched4 workload with a shared 128-token system prompt

@@ -190,10 +190,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "prefix_tokens_reused metrics")
     p.add_argument("--no-sched-overlap", action="store_true",
                    help="slot scheduler: disable the two-deep overlapped "
-                        "dispatch pipeline (device-fed speculative decode "
+                        "dispatch pipeline (device-fed pipelined decode "
                         "bursts) and dispatch fully synchronously — debug "
                         "switch and A/B baseline; greedy output is "
                         "byte-identical either way (docs/PERF.md)")
+    p.add_argument("--spec", choices=("off", "pld", "draft"), default="off",
+                   help="slot scheduler: per-slot speculative decoding "
+                        "(runtime/spec.py).  'pld' drafts from a per-slot "
+                        "prompt-lookup n-gram index (zero extra model "
+                        "cost), 'draft' from a second smaller model "
+                        "(--draft-model).  Greedy output stays "
+                        "byte-identical to 'off'; sampled (temperature>0) "
+                        "requests decode normally (docs/PERF.md)")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="speculative decoding: max draft tokens proposed "
+                        "per slot per verify window (window width is "
+                        "spec-k+1 and rides the compile key, so changing "
+                        "it mints one new executable)")
+    p.add_argument("--draft-model", default=None,
+                   help="--spec draft: path to the draft model (same "
+                        "format as the target; loaded like --model onto "
+                        "the same mesh with a slot-aligned contiguous KV "
+                        "cache)")
     p.add_argument("--no-preempt", action="store_true",
                    help="QoS: disable priority preemption (paged scheduler "
                         "only); admission stays priority-ordered but a "
@@ -352,6 +370,35 @@ def load_stack(args, batch: int | None = None) -> tuple[Engine, Tokenizer]:
     if tok.vocab_size != cfg.vocab_size:
         raise SystemExit("tokenizer is incompatible with model (vocab size mismatch)")
     return engine, tok
+
+
+def load_draft_engine(args, target: Engine) -> Engine:
+    """Load ``--draft-model`` as a second, smaller Engine on the target's
+    mesh for ``--spec draft`` (runtime/spec.py DraftModelProposer): same
+    slot count and context as the target, contiguous slot-aligned KV (the
+    draft pool is tiny, paging would only add indirection).  Weights are
+    a second full load; the KV cache is the only per-slot state."""
+    import jax.numpy as jnp
+    if not args.draft_model:
+        raise SystemExit("--spec draft needs --draft-model")
+    wft = (quants.FLOAT_TYPE_BY_NAME[args.weights_float_type]
+           if args.weights_float_type else None)
+    mf = mfile.MFile(args.draft_model, weights_ftype=wft,
+                     verify=getattr(args, "verify_weights", False))
+    bft = args.buffer_float_type
+    dtype = jnp.dtype(DTYPES["bf16" if bft == "q80" else bft])
+    cfg = ModelConfig.from_spec(mf.spec, dtype=dtype)
+    if cfg.vocab_size != target.cfg.vocab_size:
+        raise SystemExit("--draft-model vocab size differs from the "
+                         "target's (drafted ids must be target token ids)")
+    print(f"💡 draft arch: {mf.spec.arch_name} "
+          f"({cfg.n_layers} layers, dim {cfg.dim})")
+    cfg, params = load_params(mf, cfg, dtype=dtype,
+                              keep_quantized=not args.dequantize,
+                              fuse=target.mesh.shape.get("tp", 1) == 1)
+    return Engine(cfg, params, mesh=target.mesh, seq_len=target.seq_len,
+                  batch=target.batch,
+                  step_timeout=getattr(args, "step_timeout", None))
 
 
 def _seed(args) -> int:

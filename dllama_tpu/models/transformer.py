@@ -482,3 +482,27 @@ def forward_slots(params: Params, cfg: ModelConfig, tokens: jax.Array,
         lambda row, i: jax.lax.dynamic_index_in_dim(row, i, 0, keepdims=False)
     )(x, idx)  # (B, D): per-row last-valid gather
     return _head(params, cfg, x_last), cache
+
+
+def forward_slots_all(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                      cache: KVCache, pos_rows: jax.Array, n_valid: jax.Array,
+                      page_table: jax.Array | None = None
+                      ) -> tuple[jax.Array, KVCache]:
+    """:func:`forward_slots` keeping EVERY position's logits (B, T, V)
+    instead of the per-row last-valid gather — the slot-verify forward.
+    Position ``j`` of row ``r`` is the model's next-token distribution
+    after consuming ``tokens[r, :j+1]``, which is exactly what acceptance
+    of a K-token proposal window needs (decode_loop.slot_verify_chunk).
+    T is small (spec_k + 1), so the (B, T, V) buffer stays modest; the
+    KV write/mask semantics — including stale writes above a row's
+    ``n_valid`` landing beyond its causal ceiling (or in the scratch
+    page when paged) — are identical to :func:`forward_slots`."""
+    t = tokens.shape[1]
+    paged = None
+    if page_table is not None:
+        ps = cache.k.shape[3]
+        pidx, oidx = paged_write_indices(page_table, pos_rows, n_valid, t, ps)
+        paged = (page_table, pidx, oidx)
+    x, cache = run_blocks(params, cfg, tokens, cache, jnp.int32(0),
+                          pos_rows=pos_rows, paged=paged)
+    return _head(params, cfg, x), cache

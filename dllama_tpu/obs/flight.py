@@ -120,7 +120,8 @@ class FlightRecorder:
                 rec.setdefault(k, v)
 
     def phase(self, rid=None, kind: str = "", **fields) -> None:
-        """Append one phase entry (``prefill_chunk`` / ``decode_burst``)."""
+        """Append one phase entry (``prefill_chunk`` / ``decode_burst`` /
+        ``verify_burst``)."""
         rid = self._rid(rid)
         if rid is None:
             return
@@ -242,7 +243,7 @@ class SlotTimeline:
         the host-side gap the device outlived (reported here and in the
         hidden-gap counter, NOT silently dropped — and not double-counted
         into ``host_gap_ms``, which stays the *exposed* gap).
-        ``discarded`` marks a speculative dispatch thrown away at a
+        ``discarded`` marks a pipelined dispatch thrown away at a
         pipeline flush point: its tokens were never fanned out."""
         with self._lock:
             self._seq += 1

@@ -649,8 +649,26 @@ SCHED_HOST_GAP_HIDDEN_MS = REGISTRY.counter(
     "counted into sched_step_time_ms components).")
 SCHED_OVERLAP_DISCARDS = REGISTRY.counter(
     "sched_overlap_discards",
-    "Speculative dispatches landed and thrown away at a pipeline flush "
+    "Pipelined dispatches landed and thrown away at a pipeline flush "
     "point (admission, retire, cancel/deadline, drain, hand-off export).")
+
+# speculative decoding (runtime/spec.py proposers + the scheduler's
+# ragged verify bursts, --spec).  Proposed counts drafts fed into verify
+# dispatches; accepted counts the leading drafts the target model's own
+# argmax confirmed.  accepted/proposed is the acceptance rate that sets
+# the speedup (each accepted draft is one extra token per weight read).
+SCHED_SPEC_PROPOSED = REGISTRY.counter(
+    "sched_spec_proposed",
+    "Draft tokens proposed into slot-verify dispatches (--spec).")
+SCHED_SPEC_ACCEPTED = REGISTRY.labeled_counter(
+    "sched_spec_accepted", ("proposer",),
+    "Proposed draft tokens the verify step accepted, by proposer "
+    "(pld / draft).")
+SCHED_SPEC_ACCEPT_RATIO = REGISTRY.gauge(
+    "sched_spec_accept_ratio",
+    "Cumulative accepted/proposed draft-token ratio since start "
+    "(0 until the first proposal; collapses toward 0 under a reject "
+    "storm while served bytes stay exact).")
 
 # multi-tenant QoS (runtime/scheduler.py preemption + server shedding).
 # A higher-priority request that cannot admit evicts the lowest-priority

@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 
 from ..models.config import ModelConfig
-from ..models.transformer import KVCache, forward_last, forward_slots
+from ..models.transformer import (KVCache, forward_last, forward_slots,
+                                  forward_slots_all)
 from ..ops.kernels import softmax_f32
 
 
@@ -157,3 +158,54 @@ def slot_chunk(params, cfg: ModelConfig, cache: KVCache, tokens: jax.Array,
     else:
         toks, last = first[None], first
     return toks, cache, last
+
+
+def slot_verify_chunk(params, cfg: ModelConfig, cache: KVCache,
+                      tokens: jax.Array, pos_rows: jax.Array,
+                      n_valid: jax.Array, key: jax.Array, temps: jax.Array,
+                      topps: jax.Array, *, greedy: bool,
+                      page_table: jax.Array | None = None):
+    """One ragged slot-verify dispatch (speculative decoding's verify
+    side, Leviathan et al. 2023 greedy rule): row ``r`` feeds
+    ``[last_token, d_1..d_{n_valid[r]-1}]`` — its previous sample plus
+    its proposed draft tokens — and gets back the model's prediction at
+    every fed position plus the count of leading drafts that matched.
+
+    Returns ``(preds (B, T), cache, accepted (B,), last (B,))``:
+
+    * ``preds[r, j]`` is the true next token after ``tokens[r, :j+1]``
+      (argmax for greedy rows, so every emitted token is byte-identical
+      to plain decode); the caller emits ``preds[r, :accepted[r]+1]`` —
+      the matched drafts re-derived from the model's own argmax, plus
+      the one bonus token the verify forward gives for free.
+    * ``accepted[r]`` counts the leading ``preds``-matching drafts,
+      clamped to ``n_valid[r] - 1`` so a no-proposal row (``n_valid``
+      1) degrades to one plain decode step — one slot speculating never
+      perturbs a neighbor that isn't.
+    * ``last[r] = preds[r, accepted[r]]`` stays device-resident so a
+      pipelined caller could feed it onward like slot_chunk's ``last``.
+
+    Rows with temperature > 0 never carry proposals (the scheduler only
+    drafts for greedy rows); their position-0 prediction is drawn with
+    their own sampling params so riding a verify burst is equivalent to
+    riding a decode burst.  KV rows written for rejected drafts sit
+    above the row's accepted ceiling — dead by the same causal-ceiling
+    masking that makes slot reuse free.
+    """
+    logits, cache = forward_slots_all(params, cfg, tokens, cache, pos_rows,
+                                      n_valid, page_table=page_table)
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, T)
+    if not greedy:
+        key, sub = jax.random.split(key)
+        first = device_sample_rows(logits[:, 0], sub, temps, topps, greedy)
+        preds = preds.at[:, 0].set(first)
+    t = tokens.shape[1]
+    # leading-match count: draft j (fed at column j+1) is accepted iff it
+    # equals the model's prediction at column j and every earlier draft
+    # was accepted too — cumprod turns the match mask into leading-ones
+    ok = (tokens[:, 1:] == preds[:, :-1]) \
+        & (jnp.arange(t - 1)[None, :] < (n_valid - 1)[:, None])
+    accepted = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+    accepted = accepted.astype(jnp.int32)  # (B,)
+    last = jnp.take_along_axis(preds, accepted[:, None], axis=1)[:, 0]
+    return preds, cache, accepted, last

@@ -1260,6 +1260,98 @@ class Engine:
             topps_np=topps_np, steps=steps,
             page_tables_np=page_tables_np).wait()
 
+    def slot_verify_async(self, tokens_np: np.ndarray,
+                          pos_rows_np: np.ndarray, n_valid_np: np.ndarray, *,
+                          temps_np: np.ndarray, topps_np: np.ndarray,
+                          page_tables_np: np.ndarray | None = None
+                          ) -> "SlotVerifyDispatch":
+        """Enqueue one ragged slot-VERIFY dispatch (the batched,
+        per-slot generalization of :meth:`_verify_fn`'s single-stream
+        verify window): row ``r`` feeds its previous sample plus
+        ``n_valid_np[r] - 1`` proposed draft tokens at positions
+        ``pos_rows_np[r]..``, and the landed result carries the model's
+        prediction at every fed position plus the per-row count of
+        accepted leading drafts (decode_loop.slot_verify_chunk).
+
+        A row with ``n_valid`` 1 carries no proposal and rides the burst
+        as one plain decode step — the scheduler mixes proposing and
+        non-proposing slots freely in a single dispatch, so one slot
+        speculating never stalls a neighbor.  Rejected drafts wrote KV
+        above their row's accepted ceiling; those entries are dead under
+        the causal-ceiling masking (or redirected harmlessly in paged
+        mode) exactly like slot-reuse garbage, so rejection truncates
+        that row only and costs nothing to undo.
+
+        Compiled per ``(T, all-greedy)``; the verified next-token row
+        ``last_dev`` stays device-resident on the handle so a caller can
+        feed it onward like :meth:`slot_step_async`'s ``feed_dev``.
+        Same engine-state discipline as ``slot_step_async``: slot clocks
+        stay host-side with the scheduler; ``self.pos`` is untouched.
+        """
+        from .decode_loop import slot_verify_chunk
+        if self.sp > 1:
+            raise ValueError("slot serving is not supported on sp meshes "
+                             "(sequence-sharded cache); use sp=1")
+        if self.cache.quantized:
+            raise ValueError("slot serving needs a dense KV cache "
+                             "(per-row quantized writes are not wired)")
+        if self.paged and page_tables_np is None:
+            raise ValueError("paged engine: slot_verify needs page_tables_np")
+        if not self.paged and page_tables_np is not None:
+            raise ValueError("page tables passed to a contiguous engine")
+        t = int(tokens_np.shape[1])
+        if t < 2:
+            raise ValueError("slot_verify needs T >= 2 (a previous sample "
+                             "plus at least one proposal column)")
+        if int(np.max(n_valid_np)) > t:
+            raise ValueError("n_valid exceeds the verify window width")
+        # every fed column writes KV at pos..pos+T-1 (invalid columns land
+        # above the ceiling / in the scratch page), so the whole window
+        # must fit — same refusal as slot_step_async
+        hi = int(np.max(pos_rows_np)) + t
+        if hi > self.seq_len:
+            raise ContextOverflow(
+                f"slot verify would write position {hi - 1} past seq_len "
+                f"{self.seq_len}; retire rows at the context edge first")
+        greedy = bool(np.all(temps_np == 0.0))
+        key = ("slot_verify_paged" if self.paged else "slot_verify",
+               t, greedy)
+        fresh = key not in self._chunk_fns
+        if fresh:
+            cfg = self.cfg
+            if self.paged:
+                self._chunk_fns[key] = jax.jit(
+                    lambda p, c, tok, pr, nv, k, tm, tp, ptab:
+                    slot_verify_chunk(p, cfg, c, tok, pr, nv, k, tm, tp,
+                                      greedy=greedy, page_table=ptab),
+                    donate_argnums=(1,),
+                    out_shardings=(self._rep, self._cache_sh,
+                                   self._rep, self._rep))
+            else:
+                self._chunk_fns[key] = jax.jit(
+                    lambda p, c, tok, pr, nv, k, tm, tp:
+                    slot_verify_chunk(p, cfg, c, tok, pr, nv, k, tm, tp,
+                                      greedy=greedy),
+                    donate_argnums=(1,),
+                    out_shardings=(self._rep, self._cache_sh,
+                                   self._rep, self._rep))
+        self._note_executable(fresh, key=key)
+        fn = self._chunk_fns[key]
+        sub = jax.random.fold_in(self._key, self._chunk_counter)
+        self._chunk_counter += 1
+        t0 = time.perf_counter()
+        args = (self.params, self.cache, jnp.asarray(tokens_np, jnp.int32),
+                jnp.asarray(pos_rows_np, jnp.int32),
+                jnp.asarray(n_valid_np, jnp.int32), sub,
+                jnp.asarray(temps_np, jnp.float32),
+                jnp.asarray(topps_np, jnp.float32))
+        if self.paged:
+            args = args + (jnp.asarray(page_tables_np, jnp.int32),)
+        with active_mesh(self.mesh):
+            preds_dev, self.cache, accepted_dev, last_dev = fn(*args)
+        return SlotVerifyDispatch(self, preds_dev, accepted_dev, last_dev,
+                                  t=t, fresh=fresh, enqueued_at=t0)
+
     # ------------------------------------------------------------------
     def score_batch(self, sequences: list[list[int]], top_k: int = 0
                     ) -> tuple[np.ndarray, np.ndarray | None, np.ndarray | None]:
@@ -1572,4 +1664,51 @@ class SlotDispatch:
         obs_trace.record("slot_step", self.enqueued_at, t1,
                          t=self.t, steps=self.steps)
         self._out = np.asarray(self.tokens_dev)  # (steps, B)
+        return self._out
+
+
+class SlotVerifyDispatch:
+    """Completion handle for one in-flight
+    :meth:`Engine.slot_verify_async` dispatch.
+
+    ``preds_dev`` (B, T) holds the model's prediction at every fed
+    position, ``accepted_dev`` (B,) the per-row count of leading drafts
+    that matched, and ``last_dev`` (B,) the verified next token
+    (``preds[r, accepted[r]]``) kept device-resident for onward feeding.
+    ``wait()`` mirrors :class:`SlotDispatch.wait` — fault point + step
+    watchdog via :meth:`Engine._sync`, compile-histogram feed on a fresh
+    executable, ``last_slot_dispatch_ms`` — and returns
+    ``(preds, accepted)`` as host arrays in one boundary crossing.
+    """
+
+    __slots__ = ("_engine", "preds_dev", "accepted_dev", "last_dev", "t",
+                 "fresh", "enqueued_at", "ready_at", "_out")
+
+    def __init__(self, engine, preds_dev, accepted_dev, last_dev, *,
+                 t: int, fresh: bool, enqueued_at: float):
+        self._engine = engine
+        self.preds_dev = preds_dev
+        self.accepted_dev = accepted_dev
+        self.last_dev = last_dev
+        self.t = t
+        self.fresh = fresh
+        self.enqueued_at = enqueued_at  # perf_counter at enqueue
+        self.ready_at: float | None = None
+        self._out: tuple[np.ndarray, np.ndarray] | None = None
+
+    def wait(self) -> tuple[np.ndarray, np.ndarray]:
+        """Block until the verify lands; returns ``(preds (B, T),
+        accepted (B,))`` as host int32 arrays."""
+        if self._out is not None:
+            return self._out
+        eng = self._engine
+        eng._sync(self.preds_dev, "slot verify")
+        t1 = time.perf_counter()
+        self.ready_at = t1
+        if self.fresh:  # first call blocked through trace + compile
+            obs_metrics.ENGINE_COMPILE_S.observe(t1 - self.enqueued_at)
+        eng.last_slot_dispatch_ms = (t1 - self.enqueued_at) * 1e3
+        obs_trace.record("slot_verify", self.enqueued_at, t1, t=self.t)
+        self._out = (np.asarray(self.preds_dev),
+                     np.asarray(self.accepted_dev))
         return self._out

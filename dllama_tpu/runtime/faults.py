@@ -26,6 +26,10 @@ Fault points (the arming side never needs code changes to add more —
   (io/mfile.py); ``corrupt`` flips a byte of the returned buffer — the
   deterministic stand-in for storage corruption the checksum manifest
   must catch.
+* ``spec.propose``          — in the speculative-decoding proposer
+  (runtime/spec.py) before drafts are returned; ``corrupt`` replaces
+  every slot's draft with adversarial tokens chosen to never match the
+  target model — the reject-storm worst case for the verify path.
 * ``engine.numeric``        — at the engine's logits numeric guard
   (runtime/engine.py, ``--numeric-checks``); ``nan`` poisons the checked
   logits so the ``NumericFault`` path is testable without real
@@ -45,8 +49,9 @@ Spec grammar (``DLLAMA_FAULTS`` or :meth:`FaultRegistry.install`)::
 * ``nan``            — ask the call site to poison its value (the site
   reads the action list ``fire()`` returns; ``engine.device_step`` and
   ``engine.numeric`` honor it, by NaN-filling the fetched logits).
-* ``corrupt``        — ask the call site to flip a byte of its value
-  (``io.read_tensor`` honors it).
+* ``corrupt``        — ask the call site to corrupt its value
+  (``io.read_tensor`` flips a byte; ``spec.propose`` swaps the drafts
+  for adversarial tokens).
 * ``@skip``          — stay dormant for the first ``skip`` hits (fire
   starting on hit ``skip+1``).
 * ``xtimes``         — fire at most ``times`` times, then go dormant

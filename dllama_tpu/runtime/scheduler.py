@@ -33,10 +33,25 @@ Mechanics per dispatch:
   device→host→device round trip).  Every *flush point* — a queued
   ticket awaiting admission, slot retire, cancel/deadline,
   ``exclusive()`` parking, hand-off export/import, drain — falls back
-  to synchronous dispatch: the speculative dispatch is landed and
+  to synchronous dispatch: the pipelined dispatch is landed and
   discarded, its KV writes sit above every surviving row's position
   (masked by the causal ceiling exactly like slot reuse), and greedy
-  output stays byte-identical with overlap on or off.
+  output stays byte-identical with overlap on or off;
+* with a ``spec`` proposer armed (runtime/spec.py, ``--spec``), each
+  greedy decode slot drafts up to ``spec_k`` tokens after a burst
+  lands, and the next dispatch is a ragged VERIFY burst
+  (``Engine.slot_verify_async``): proposing rows feed their drafts,
+  no-proposal rows ride as plain decode steps, and each row emits its
+  accepted leading drafts plus one bonus token — all re-derived from
+  the target model's own argmax, so greedy output is byte-identical
+  with speculation on or off.  Rejection truncates that row only
+  (stale KV above its accepted ceiling is slot-reuse garbage), and
+  every flush point above drops pending drafts the same way it drops a
+  pipelined dispatch: drafts never survive a retire, park, or export.
+  Speculation supersedes burst pipelining while armed (a verify
+  window's content depends on the previous dispatch's landed tokens,
+  so there is nothing token-independent to pipeline); the verify
+  burst's multi-token yield is what amortizes the host gap instead.
 
 Each submitted request gets a :class:`Ticket` — a thread-safe token
 stream the HTTP handler consumes.  Cancellation (client disconnect, stop
@@ -125,6 +140,10 @@ class Ticket:
         # scheduler thread, where the contextvar is not set — spans, logs
         # and the flight record all stamp this one grep-able ID
         self.rid: str = request_id_var.get() or new_request_id()
+        # speculative decoding: draft tokens proposed for / accepted by
+        # this request's verify bursts (flight record + /debug/requests)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         self._q: queue.SimpleQueue = queue.SimpleQueue()
         self._cancel: str | None = None
         self._on_cancel = None  # scheduler wakeup, bound at submit
@@ -192,7 +211,7 @@ class _Pending:
                  "t_width", "n_valid", "temps", "topps", "prefset",
                  "rid_by_slot", "fed_by_slot", "pos_rows", "enq_tp",
                  "t0_mono", "host_gap_ms", "idle_ms", "overlapped",
-                 "queued")
+                 "queued", "verify", "proposed_by_slot")
 
     def __init__(self, **kw):
         for k in self.__slots__:
@@ -210,7 +229,8 @@ class SlotScheduler:
                  overlap: bool = True, preempt: bool = True,
                  preempt_age_ms: float = 5000.0, preempt_cap: int = 3,
                  parked_max: int | None = None,
-                 spill_dir: str | None = None):
+                 spill_dir: str | None = None,
+                 spec=None, spec_k: int = 4):
         if engine.sp > 1:
             raise ValueError("slot scheduling is not supported on sp meshes")
         if engine.cache.quantized:
@@ -269,9 +289,19 @@ class SlotScheduler:
         # is additionally read under _cond by _flushed() waiters, and
         # _flush_req is written by them.
         self.overlap = bool(overlap)
-        self._inflight_n = 0     # speculative dispatches on device
-        self._flush_req = 0      # >0: flush requested, speculation blocked
+        self._inflight_n = 0     # pipelined dispatches on device
+        self._flush_req = 0      # >0: flush requested, pipelining blocked
         self._depth = 0          # dispatches enqueued but not yet landed
+        # speculative decoding (runtime/spec.py): proposer instance (or
+        # None = off) and per-slot pending drafts collected at land time,
+        # each tagged with the ticket it was drafted for so a re-bound
+        # slot can never consume a predecessor's drafts.  All spec state
+        # is host-side and scheduler-thread-only; flush points clear it.
+        self.spec = spec
+        self.spec_k = max(1, int(spec_k))
+        self._proposals: dict[int, tuple[Ticket, list[int]]] = {}
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         self._n_dispatched = 0
         self._n_overlapped = 0
         self._park_wakeups = 0   # parked-wait iterations (idle test hook)
@@ -403,8 +433,8 @@ class SlotScheduler:
     # -- pipeline flush ------------------------------------------------
     @contextlib.contextmanager
     def _flushed(self):
-        """Hold the dispatch pipeline empty: block new speculation, wait
-        for any in-flight speculative dispatch to land (it is discarded
+        """Hold the dispatch pipeline empty: block new pipelining, wait
+        for any in-flight pipelined dispatch to land (it is discarded
         at the flush point), then yield with ``self._cond`` held and
         zero dispatches in flight.  The DLREQ01 exporter runs inside
         this window so its snapshots never observe a half-landed
@@ -521,9 +551,12 @@ class SlotScheduler:
         if self.pool is None:
             return {}
         records: dict[str, bytes] = {}
-        # _flushed() lands-and-discards any in-flight speculative
+        # _flushed() lands-and-discards any in-flight pipelined
         # dispatch before yielding, so every snapshot below observes
-        # step-boundary state only (acceptance: zero in-flight here)
+        # step-boundary state only (acceptance: zero in-flight here).
+        # Token speculation flushes too: the export path runs _retire
+        # (via handoff) which drops the slot's pending drafts, so a
+        # DLREQ01 record never carries speculative state
         with self._flushed():
             for i in self._active():
                 t = self.slots[i].ticket
@@ -707,6 +740,12 @@ class SlotScheduler:
         t.finish = reason
         t.error = error
         s.ticket = None
+        # flush point for speculation: pending drafts die with the slot
+        # and the proposer forgets its per-slot state (a later occupant
+        # rebuilds from its own prompt)
+        self._proposals.pop(slot_idx, None)
+        if self.spec is not None:
+            self.spec.reset(slot_idx)
         if self.pool is not None and s.pages:
             # drop this slot's references; pages the radix tree retained
             # stay live (and reusable by the next matching prompt)
@@ -730,7 +769,10 @@ class SlotScheduler:
                           error=repr(error) if error is not None else None,
                           preempt_count=t.preempt_count or None,
                           parked_ms=round(t.parked_ms, 3)
-                          if t.parked_ms else None)
+                          if t.parked_ms else None,
+                          spec_proposed=t.spec_proposed or None,
+                          spec_accepted=t.spec_accepted
+                          if t.spec_proposed else None)
         t._q.put(_DONE)
 
     def _fail_ticket(self, t: Ticket, reason: str,
@@ -932,6 +974,12 @@ class SlotScheduler:
         whatever tokens it produced."""
         s = self.slots[slot_idx]
         t = s.ticket
+        # flush point: pending drafts are discarded BEFORE the export so
+        # a DLREQ01 record never carries speculative state — the resumed
+        # slot re-drafts from its own (exact) accepted stream
+        self._proposals.pop(slot_idx, None)
+        if self.spec is not None:
+            self.spec.reset(slot_idx)
         obs_metrics.SCHED_PREEMPTIONS.inc(reason)
         obs_trace.record("sched_preempt", now, time.monotonic(), rid=t.rid,
                          slot=slot_idx, reason=reason, produced=s.produced,
@@ -1188,19 +1236,19 @@ class SlotScheduler:
         plain step boundary."""
         cur = self._enqueue_first(active, queued)
         while True:
-            spec = None
+            nxt = None
             if cur.error is None and self.overlap:
-                spec = self._maybe_speculate(cur)
+                nxt = self._maybe_pipeline(cur)
             ok = self._land_and_fanout(cur)
-            if not ok or spec is None:
-                if spec is not None:
-                    self._abandon(spec)
+            if not ok or nxt is None:
+                if nxt is not None:
+                    self._abandon(nxt)
                 return
-            survivors = self._pipeline_verdict(spec)
+            survivors = self._pipeline_verdict(nxt)
             if survivors is None:
-                self._abandon(spec)
+                self._abandon(nxt)
                 return
-            cur = spec
+            cur = nxt
 
     def _enqueue_first(self, active: list[int], queued: int) -> _Pending:
         """Build and enqueue the round's first (host-fed) dispatch.
@@ -1212,13 +1260,38 @@ class SlotScheduler:
         prefilling = [i for i in active
                       if slots[i].fed < len(slots[i].ticket.prompt)]
         room = min(eng.seq_len - slots[i].pos for i in active)
+        # consume the slots' pending draft proposals (runtime/spec.py).
+        # Proposals are valid for exactly the next dispatch after the
+        # burst that produced them — decode rows advance every dispatch —
+        # so they are popped unconditionally here and re-validated:
+        # identity-checked against the slot's *current* ticket (retire /
+        # park / import all rebind), dropped whole when a prefilling row
+        # joins (the mixed step has no verify shape) or the context edge
+        # is closer than a full verify window (flush, not truncate: the
+        # proposer re-drafts next round from exact state either way)
+        props: dict[int, list[int]] = {}
+        if self.spec is not None:
+            with self._cond:
+                pend, self._proposals = self._proposals, {}
+            if not prefilling and room >= self.spec_k + 1:
+                for i, (tk, d) in pend.items():
+                    if i in active and slots[i].ticket is tk and d:
+                        props[i] = d
         # both dispatch dimensions ride the compile key (engine.slot_step
         # caches per (T, steps, greedy)), so each is rounded down to a
         # power of two: transient values — a neighbor 3 tokens from its
         # prompt end, a row 2 tokens from its budget — would otherwise
         # mint one-off executables (PR-4 compile telemetry made that
         # visible).  O(log chunk × log burst) shapes total, each reusable.
-        if prefilling:
+        if props:
+            # ragged verify burst: a fixed T = spec_k + 1 window (one
+            # compile key per spec_k), rows with proposals feed
+            # [last, d_1..d_k] and rows without ride along as plain
+            # single-token decode (n_valid 1) — one slot speculating
+            # never stalls a neighbor that has nothing to propose
+            t_width = self.spec_k + 1
+            steps = 1
+        elif prefilling:
             # mixed step: prefill chunks ride along with the decode rows'
             # single tokens; steps=1 keeps every row's clock advancing by
             # its own n_valid
@@ -1259,6 +1332,10 @@ class SlotScheduler:
                 n_valid[i] = c
             else:
                 tokens[i, 0] = s.last
+                d = props.get(i)
+                if d is not None:
+                    tokens[i, 1:1 + len(d)] = d
+                    n_valid[i] = 1 + len(d)
 
         obs_metrics.SCHED_BATCH_EFFICIENCY.set(len(active) / b)
         prefset = set(prefilling)
@@ -1285,11 +1362,18 @@ class SlotScheduler:
         handle, error = None, None
         try:
             with self._engine_lock:
-                handle = eng.slot_step_async(
-                    tokens, pos_rows, n_valid, temps_np=temps,
-                    topps_np=topps, steps=steps,
-                    page_tables_np=self._page_tables
-                    if self.paged else None)
+                if props:
+                    handle = eng.slot_verify_async(
+                        tokens, pos_rows, n_valid, temps_np=temps,
+                        topps_np=topps,
+                        page_tables_np=self._page_tables
+                        if self.paged else None)
+                else:
+                    handle = eng.slot_step_async(
+                        tokens, pos_rows, n_valid, temps_np=temps,
+                        topps_np=topps, steps=steps,
+                        page_tables_np=self._page_tables
+                        if self.paged else None)
         except Exception as e:
             error = e
         if handle is not None:
@@ -1302,15 +1386,28 @@ class SlotScheduler:
                         fed_by_slot=fed_by_slot, pos_rows=pos_rows,
                         enq_tp=tp0, t0_mono=time.monotonic(),
                         host_gap_ms=host_gap_ms, idle_ms=idle_ms,
-                        overlapped=False, queued=queued)
+                        overlapped=False, queued=queued,
+                        verify=bool(props),
+                        proposed_by_slot={i: len(d)
+                                          for i, d in props.items()})
 
-    def _maybe_speculate(self, cur: _Pending) -> _Pending | None:
-        """While ``cur`` is still in flight, enqueue the next pure-decode
-        burst fed by ``cur``'s on-device last-token row.  Returns None at
-        any pipeline flush point — queued admission pending, drain /
+    def _maybe_pipeline(self, cur: _Pending) -> _Pending | None:
+        """While ``cur`` is still in flight, speculate on the next burst:
+        enqueue the next pure-decode dispatch fed by ``cur``'s on-device
+        last-token row.  ("Speculate" here is dispatch pipelining — a
+        guess that no flush point interrupts the round — not token
+        speculation; that is the ``spec`` proposer's job.)  Returns None
+        at any pipeline flush point — queued admission pending, drain /
         pause / flush request, cancel or expired deadline, a row still
         mid-prefill after ``cur``, a hand-off import, no context room —
         and the round then completes synchronously."""
+        if self.spec is not None:
+            # token speculation supersedes burst pipelining: a verify
+            # window's *content* (the draft tokens) depends on the
+            # previous dispatch's landed tokens, so the next dispatch
+            # cannot be built while ``cur`` is in flight.  The verify
+            # burst's multi-token yield amortizes the host gap instead.
+            return None
         eng = self.engine
         slots = self.slots
         b = eng.batch
@@ -1342,7 +1439,7 @@ class SlotScheduler:
             if budget < 1:
                 # every row hits its token budget during ``cur``: unlike
                 # the sync path (which only learns a row retired after
-                # the burst lands), the speculation knows its
+                # the burst lands), the pipelined dispatch knows its
                 # predecessor's yield up front, so the all-overrun burst
                 # is avoidable waste, not a shape-count trade
                 return None
@@ -1375,7 +1472,7 @@ class SlotScheduler:
             with self._cond:
                 self._inflight_n -= 1
                 self._cond.notify_all()
-            _log.error("speculative enqueue failed; round completes "
+            _log.error("pipelined enqueue failed; round completes "
                        "synchronously", extra={"error": repr(err)})
             return None
         self._depth += 1
@@ -1485,11 +1582,20 @@ class SlotScheduler:
             # under load, not an idle microbenchmark
             with self._engine_lock:
                 self.engine.probe_collective()
-        obs_trace.record("sched_step", cur.t0_mono, time.monotonic(),
-                         active=n_act, queued=cur.queued,
-                         t=cur.t_width, steps=cur.steps,
-                         overlapped=cur.overlapped,
-                         rids=sorted(cur.rid_by_slot.values()))
+        if cur.verify:
+            preds, accepted = out
+            n_prop = sum(cur.proposed_by_slot.values())
+            n_acc = sum(int(accepted[i]) for i in cur.proposed_by_slot)
+            obs_trace.record("sched_verify", cur.t0_mono, time.monotonic(),
+                             active=n_act, queued=cur.queued,
+                             t=cur.t_width, proposed=n_prop, accepted=n_acc,
+                             rids=sorted(cur.rid_by_slot.values()))
+        else:
+            obs_trace.record("sched_step", cur.t0_mono, time.monotonic(),
+                             active=n_act, queued=cur.queued,
+                             t=cur.t_width, steps=cur.steps,
+                             overlapped=cur.overlapped,
+                             rids=sorted(cur.rid_by_slot.values()))
 
         FAULTS.fire("sched.host_fanout")
         emitted = dict.fromkeys(cur.active, 0)
@@ -1498,7 +1604,12 @@ class SlotScheduler:
         # emitted list must never be observable half-advanced by the
         # hand-off exporter, which snapshots them from another thread
         with self._cond:
-            self._fanout(cur.active, cur.steps, out, cur.n_valid, emitted)
+            if cur.verify:
+                self._fanout_verify(cur.active, preds, accepted,
+                                    cur.proposed_by_slot, emitted)
+            else:
+                self._fanout(cur.active, cur.steps, out, cur.n_valid,
+                             emitted)
 
         # flight phases + timeline entry for this dispatch (after the
         # fanout so the emitted-token counts are final; a row retired
@@ -1514,6 +1625,11 @@ class SlotScheduler:
                                  tokens=cur.fed_by_slot[i], ms=wall_ms,
                                  pos=int(cur.pos_rows[i]),
                                  emitted=emitted[i])
+            elif cur.verify:
+                obs_flight.phase(rid, "verify_burst",
+                                 proposed=cur.proposed_by_slot.get(i, 0),
+                                 accepted=int(accepted[i]),
+                                 tokens=emitted[i], wall_ms=wall_ms)
             else:
                 obs_flight.phase(rid, "decode_burst", steps=cur.steps,
                                  tokens=emitted[i], wall_ms=wall_ms,
@@ -1526,13 +1642,15 @@ class SlotScheduler:
             hidden_host_ms=hidden_ms,
             slots=self._slot_entries(cur.active, cur.prefset,
                                      cur.rid_by_slot, emitted))
+        if self.spec is not None:
+            self._collect_proposals()
         return True
 
-    def _pipeline_verdict(self, spec: _Pending) -> list[int] | None:
-        """After ``spec``'s predecessor landed and fanned out with
-        ``spec`` still in flight: decide whether ``spec``'s tokens may
+    def _pipeline_verdict(self, nxt: _Pending) -> list[int] | None:
+        """After ``nxt``'s predecessor landed and fanned out with
+        ``nxt`` still in flight: decide whether ``nxt``'s tokens may
         be emitted.  Returns the surviving slot list, or None for a hard
-        flush (``spec`` must be discarded).  A slot that merely retired
+        flush (``nxt`` must be discarded).  A slot that merely retired
         in the predecessor's fanout (EOS / budget) survives row-wise
         removal — the burst computed its row for nothing, which is
         cheaper than flushing the whole pipeline."""
@@ -1545,14 +1663,14 @@ class SlotScheduler:
             survivors = []
             for j in range(len(slots)):
                 s = slots[j]
-                if j not in spec.tickets:
+                if j not in nxt.tickets:
                     if s.ticket is not None:
                         return None   # import bound a slot mid-pipeline
                     continue
                 t = s.ticket
                 if t is None:
                     continue          # retired by the predecessor's fanout
-                if t is not spec.tickets[j]:
+                if t is not nxt.tickets[j]:
                     return None       # slot re-bound (import into freed row)
                 if t._cancel is not None or (t.deadline is not None
                                              and now >= t.deadline):
@@ -1560,23 +1678,23 @@ class SlotScheduler:
                 survivors.append(j)
             if not survivors:
                 return None
-            spec.active = survivors
-            spec.tickets = {j: spec.tickets[j] for j in survivors}
-            spec.rid_by_slot = {j: spec.rid_by_slot[j] for j in survivors}
+            nxt.active = survivors
+            nxt.tickets = {j: nxt.tickets[j] for j in survivors}
+            nxt.rid_by_slot = {j: nxt.rid_by_slot[j] for j in survivors}
             return survivors
 
-    def _abandon(self, spec: _Pending) -> None:
-        """Land and discard an in-flight speculative dispatch at a flush
+    def _abandon(self, nxt: _Pending) -> None:
+        """Land and discard an in-flight pipelined dispatch at a flush
         point.  No slot clock ever advanced for it and its tokens are
         never emitted, so greedy output is byte-identical to never
-        having speculated: its KV writes all sit above every surviving
+        having pipelined: its KV writes all sit above every surviving
         row's position — masked by the causal ceiling and rewritten
         identically by the synchronous redo dispatch, exactly like slot
         reuse.  The sampler RNG tick it consumed is not rewound: sampled
         draws are co-scheduling-dependent by contract (module
         docstring); greedy rows never touch the stream."""
         try:
-            spec.handle.wait()
+            nxt.handle.wait()
         except Exception as e:
             # the discarded dispatch owns its own failure — nothing was
             # emitted from it; the next live dispatch re-probes the device
@@ -1599,7 +1717,7 @@ class SlotScheduler:
         self._account("pad", wall_ms)
         obs_metrics.SCHED_OVERLAP_DISCARDS.inc()
         obs_flight.TIMELINE.record_step(
-            ts=prev_end, wall_ms=wall_ms, steps=spec.steps, t_width=1,
+            ts=prev_end, wall_ms=wall_ms, steps=nxt.steps, t_width=1,
             overlapped=True, discarded=True,
             slots=self._slot_entries([], set(), {}, {}))
 
@@ -1660,3 +1778,106 @@ class SlotScheduler:
                 if s.produced >= t.max_new or s.pos >= eng.seq_len:
                     with self._cond:
                         self._retire(i, "length")
+
+    def _fanout_verify(self, active: list[int], preds, accepted,
+                       proposed_by_slot: dict[int, int],
+                       emitted: dict[int, int]) -> None:
+        """Distribute one verify dispatch's tokens and advance the slot
+        clocks.  Row ``i`` emits ``preds[i, :accepted[i]+1]`` — every
+        token is the model's own (argmax) prediction, so the stream is
+        byte-identical to plain decode; the drafts only chose how many
+        positions one dispatch got to check.  A rejection truncates that
+        row alone (its clock advances by its own accepted count; the
+        rejected tail's KV sits above the new position, dead under the
+        causal ceiling).  EOS or budget mid-window retires the row and
+        discards the rest of its window, exactly like a decode burst.
+        Caller holds ``self._cond``."""
+        eng = self.engine
+        slots = self.slots
+        for i in active:
+            s = slots[i]
+            t = s.ticket
+            if t is None:  # retired between enqueue and land
+                continue
+            a = int(accepted[i])
+            k = proposed_by_slot.get(i, 0)
+            if k:
+                t.spec_proposed += k
+                t.spec_accepted += a
+                self._spec_proposed += k
+                self._spec_accepted += a
+                obs_metrics.SCHED_SPEC_PROPOSED.inc(k)
+                if a:
+                    obs_metrics.SCHED_SPEC_ACCEPTED.inc(self.spec.name, n=a)
+            for tok in (int(preds[i, j]) for j in range(a + 1)):
+                s.pos += 1
+                s.last = tok
+                if tok in t.eos_ids:
+                    self._retire(i, "stop")
+                    break
+                s.produced += 1
+                emitted[i] += 1
+                t.emitted.append(tok)
+                t._q.put(tok)
+                if s.produced >= t.max_new or s.pos >= eng.seq_len:
+                    self._retire(i, "length")
+                    break
+        if self._spec_proposed:
+            obs_metrics.SCHED_SPEC_ACCEPT_RATIO.set(
+                self._spec_accepted / self._spec_proposed)
+
+    def _collect_proposals(self) -> None:
+        """After a dispatch fans out: let each live, greedy, decode-phase
+        slot draft up to ``spec_k`` tokens for the *next* dispatch.  Runs
+        on the scheduler thread only; slot clocks are stable here.  The
+        proposer call happens outside ``_cond`` (a draft-model proposer
+        dispatches its own engine), so storage re-validates ticket
+        identity — a slot parked or retired mid-draft simply loses its
+        proposal, which the consume-time check would also have caught."""
+        spec = self.spec
+        eng = self.engine
+        slots = self.slots
+        want: dict[int, int] = {}
+        with self._cond:
+            if (self._stop or self._draining or self._paused
+                    or self._flush_req or self._queue or self._parked):
+                # a flush point (or pending admission, which makes the
+                # next dispatch a mixed prefill step) is imminent:
+                # drafting now would be discarded at consume — refuse
+                # speculation instead of wasting proposer work
+                return
+            now = time.monotonic()
+            tick = {}
+            for i in self._active():
+                s = slots[i]
+                t = s.ticket
+                if (t.temperature != 0.0 or s.fed < len(t.prompt)
+                        or t._cancel is not None
+                        or (t.deadline is not None and now >= t.deadline)):
+                    continue
+                if eng.seq_len - s.pos < self.spec_k + 1:
+                    # the verify window is fixed at spec_k + 1 columns no
+                    # matter how few tokens this row drafts, so a row
+                    # that close to the context edge cannot ride one
+                    continue
+                # drafting past the token budget is pure waste (the
+                # fanout discards the overrun as the row retires), so k
+                # is clamped to remaining-budget - 1: the window's bonus
+                # token is the one that lands exactly on the budget
+                k = min(self.spec_k, t.max_new - s.produced - 1)
+                if k < 1:
+                    continue
+                spec.sync(i, t.rid, t.prompt, t.emitted)
+                want[i] = k
+                tick[i] = t
+        if not want:
+            return
+        props = spec.propose(want)
+        with self._cond:
+            for i, d in props.items():
+                t = slots[i].ticket
+                if t is None or t is not tick.get(i):
+                    continue
+                d = d[:want[i]]
+                if d:
+                    self._proposals[i] = (t, d)

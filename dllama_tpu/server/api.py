@@ -2513,7 +2513,7 @@ def serve(state: ApiState, host: str = "0.0.0.0", port: int = 9990, *,
 def main(argv=None):
     import sys
 
-    from ..cli import build_parser, load_stack
+    from ..cli import build_parser, load_draft_engine, load_stack
     argv = list(sys.argv[1:] if argv is None else argv)
     # reuse the dllama flag surface; the server has no positional mode
     args = build_parser().parse_args(["inference", *argv])
@@ -2531,6 +2531,12 @@ def main(argv=None):
         _log.info("slo_enabled", extra={
             "spec": slo.spec_display,
             "windows": [w for w, _ in slo.windows]})
+    if args.spec != "off" and args.batch_slots <= 0:
+        # speculation lives in the slot scheduler; failing fast beats a
+        # silently ignored flag (and beats loading a draft model for
+        # nothing)
+        raise SystemExit("--spec needs --batch-slots (speculative "
+                         "decoding runs under the slot scheduler)")
     if args.batch_slots > 0 and args.sp > 1:
         # the batch engine's ragged prefill needs the whole sequence axis
         # per shard (engine.prefill_ragged); accepting the flag would make
@@ -2562,6 +2568,13 @@ def main(argv=None):
             # the batch engine at decode-step granularity instead of
             # serializing on the engine mutex (which stays the fallback
             # path for seeded sampling, logprobs, echo, and n>1)
+            spec = None
+            if args.spec != "off":
+                from ..runtime.spec import make_proposer
+                draft_eng = (load_draft_engine(args, batch_engine)
+                             if args.spec == "draft" else None)
+                spec = make_proposer(args.spec, batch_engine,
+                                     draft_engine=draft_eng)
             scheduler = SlotScheduler(
                 batch_engine, prefill_chunk=args.sched_prefill_chunk,
                 max_wait_ms=args.sched_max_wait_ms,
@@ -2571,7 +2584,8 @@ def main(argv=None):
                 preempt=not args.no_preempt,
                 preempt_age_ms=args.preempt_age_ms,
                 preempt_cap=args.preempt_cap,
-                spill_dir=args.preempt_spill_dir)
+                spill_dir=args.preempt_spill_dir,
+                spec=spec, spec_k=args.spec_k)
             _log.info("slot_scheduler_enabled", extra={
                 "slots": args.batch_slots,
                 "prefill_chunk": args.sched_prefill_chunk,
@@ -2579,7 +2593,8 @@ def main(argv=None):
                 "paged": scheduler.paged,
                 "prefix_reuse": scheduler.prefix_cache is not None,
                 "overlap": scheduler.overlap,
-                "preempt": scheduler.preempt and scheduler.paged})
+                "preempt": scheduler.preempt and scheduler.paged,
+                "spec": args.spec, "spec_k": args.spec_k})
         except ValueError as e:
             # quantized KV / sp mesh: lockstep batch serving still works,
             # only decode-step admission is off

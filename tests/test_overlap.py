@@ -12,7 +12,7 @@ The tentpole contracts, each pinned here on CPU with a tiny model:
   traffic is identical with the pipeline on and off, including EOS
   stops and cancels (partial output is a prefix of the solo run);
 * **flush correctness** — a hand-off export fired mid-pipeline lands
-  and discards the in-flight speculative dispatch before any DLREQ01
+  and discards the in-flight pipelined dispatch before any DLREQ01
   snapshot is taken (zero in-flight observed), and the exported request
   resumes byte-identically on a peer;
 * **honest accounting** — host gap hidden behind device compute is
@@ -298,13 +298,13 @@ def test_handoff_export_flushes_pipeline(paged_solo_ref):
 
 
 def test_flush_discards_inflight_dispatch():
-    """flush() lands-and-discards the speculative dispatch: the discard
+    """flush() lands-and-discards the pipelined dispatch: the discard
     counter moves, the timeline marks the entry discarded, and greedy
     output is unaffected."""
     sched = SlotScheduler(make_engine(2), prefill_chunk=4, decode_burst=4,
                           overlap=True)
     # warm every executable off the clock (prefill chunk widths + the
-    # decode-burst key the speculative dispatch shares) — CPU compiles
+    # decode-burst key the pipelined dispatch shares) — CPU compiles
     # take ~1s each and would otherwise stall the timed phase below
     list(sched.submit(P2, 8).tokens())
     obs_flight.TIMELINE.clear()
@@ -323,7 +323,7 @@ def test_flush_discards_inflight_dispatch():
         sched.close()
     assert obs_metrics.SCHED_OVERLAP_DISCARDS.value > before, \
         "five flushes against a saturated pipeline never caught a " \
-        "speculative dispatch in flight"
+        "pipelined dispatch in flight"
     discarded = [e for e in obs_flight.TIMELINE.snapshot()
                  if e.get("discarded")]
     assert discarded

@@ -1,0 +1,499 @@
+"""Speculative decoding tests (runtime/spec.py proposers +
+runtime/engine.py ``slot_verify_async`` + the scheduler's ragged verify
+bursts, ``--spec``).
+
+The subsystem contracts, each pinned here on CPU with the tiny model:
+
+* **proposer units** — the prompt-lookup index never matches a suffix
+  against itself, grows incrementally, rebuilds on slot reuse; the
+  draft-model proposer credits exactly the verifier-kept drafts on
+  sync-by-replay, and an identical draft engine reproduces the target's
+  own greedy continuation;
+* **slot verify** — a ragged verify window accepts the leading
+  draft match per row, a no-proposal neighbor rides as one plain decode
+  step, and the KV the rejected drafts wrote above the accepted ceiling
+  is dead: continuing from the ceiling is byte-identical to solo;
+* **byte parity** — greedy output under ragged staggered traffic is
+  identical with ``--spec off`` / ``pld`` / ``draft``, pipeline on and
+  off, including EOS mid-verify and cancels (partial output is a prefix
+  of the solo run);
+* **acceptance** — an identical draft engine accepts ~every draft;
+  prompt lookup on a repetitive continuation clears the ratio floor;
+  counters/gauge land in both exposition formats and per-request counts
+  in the flight record;
+* **flush points** — speculation coexists with preemption park/resume
+  (zero pages leaked) and the DLREQ01 hand-off export (pending drafts
+  discarded before the snapshot, never exported);
+* **reject storm** — the ``spec.propose=corrupt`` fault's adversarial
+  drafts collapse the accept ratio while the served bytes stay the
+  model's own greedy output.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from dllama_tpu.models.config import tiny_config
+from dllama_tpu.models.params import init_params
+from dllama_tpu.obs import flight as obs_flight, metrics as obs_metrics
+from dllama_tpu.parallel.mesh import make_mesh
+from dllama_tpu.runtime import snapshot as snapfmt
+from dllama_tpu.runtime.engine import Engine
+from dllama_tpu.runtime.faults import FAULTS, injected
+from dllama_tpu.runtime.scheduler import PRIORITY_LEVELS, SlotScheduler
+from dllama_tpu.runtime.spec import (DraftModelProposer, PromptLookupProposer,
+                                     make_proposer)
+
+pytestmark = pytest.mark.spec
+
+CFG = tiny_config(seq_len=64)
+PAGE = 4
+P1 = [5, 9, 2]
+P2 = [7, 3, 11, 4, 6, 1, 8]
+P3 = [2, 4, 6]
+P4 = [9, 8, 7, 6]
+PROMPTS = (P1, P2, P3, P4)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+def make_engine(batch=1, zero=False):
+    params = init_params(CFG, seed=4)
+    if zero:
+        # zeroed weights give a constant argmax — a fully predictable
+        # continuation, the deterministic accept-ratio oracle
+        params = jax.tree_util.tree_map(lambda a: a * 0, params)
+    return Engine(CFG, params,
+                  mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+                  batch=batch)
+
+
+def make_paged_engine(batch=2, page=PAGE):
+    pages_per_slot = -(-CFG.seq_len // page)
+    return Engine(CFG, init_params(CFG, seed=4),
+                  mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+                  batch=batch,
+                  kv_pages=batch * pages_per_slot + 1,
+                  kv_page_size=page)
+
+
+@pytest.fixture(scope="module")
+def solo_refs():
+    """Greedy solo completions per prompt — the parity oracle."""
+    eng = make_engine()
+    refs = {}
+    for p in PROMPTS:
+        eng.reset()
+        toks = [t for t, _ in eng.generate_stream(
+            p, len(p) + 30, temperature=0.0, chunk=5)]
+        refs[tuple(p)] = toks[len(p):]
+    return refs
+
+
+# -- proposer units ---------------------------------------------------------
+
+def test_pld_index_lookup_and_reset():
+    """The n-gram index finds the latest earlier occurrence, never
+    self-matches, grows incrementally at sync, rebuilds on a rid change
+    and dies at reset."""
+    pr = PromptLookupProposer(ngram=2, vocab=64)
+    pr.sync(0, "r1", [1, 2, 3, 1, 2], [])
+    # suffix (1, 2) occurred earlier at 0..1 → continuation from 2
+    assert pr.propose({0: 3}) == {0: [3, 1, 2]}
+    # incremental sync: only the new emitted tokens extend the sequence
+    pr.sync(0, "r1", [1, 2, 3, 1, 2], [3, 1])
+    assert pr.propose({0: 2}) == {0: [2, 3]}
+    # no self-match: a suffix with no earlier occurrence proposes nothing
+    pr.sync(1, "r2", [7, 8], [])
+    assert pr.propose({1: 4}) == {}
+    # rid change (slot reuse / import) rebuilds from scratch
+    pr.sync(0, "r9", [5, 6], [])
+    assert pr.propose({0: 2}) == {}
+    # reset is the flush point: state is gone, nothing proposed
+    pr.sync(2, "r3", [1, 2, 3, 1, 2], [])
+    pr.reset(2)
+    assert pr.propose({2: 2}) == {}
+
+
+def test_pld_want_clamps_and_absent_slot():
+    pr = PromptLookupProposer(ngram=2, vocab=64)
+    pr.sync(0, "r1", [4, 5, 4, 5, 4, 5], [])
+    assert pr.propose({0: 0}) == {}          # k < 1: nothing
+    assert pr.propose({3: 4}) == {}          # never-synced slot: nothing
+    got = pr.propose({0: 2})[0]
+    assert len(got) <= 2                     # never more than wanted
+
+
+def test_draft_sync_credits_kept_drafts():
+    """Sync-by-replay bookkeeping: after a drafting forward fed ``fed``
+    tokens and drafted ``drafted``, a sync carrying the verifier's kept
+    tokens credits ``fed + min(leading_match, len(drafted) - 1)`` —
+    the last draft was sampled but never fed, so its KV does not exist."""
+    pr = DraftModelProposer(make_engine(2))
+    pr.sync(0, "r1", [1, 2, 3], [])
+    st = pr._states[0]
+    st.fed, st.drafted = 3, [10, 11, 12, 13]
+    # verifier kept 10, 11 then diverged: credit fed + 2
+    pr.sync(0, "r1", [1, 2, 3], [10, 11, 9])
+    assert st.synced == 5 and st.drafted == []
+    # full acceptance still can't credit the never-fed last draft
+    st.fed, st.drafted = 6, [20, 21]
+    pr.sync(0, "r1", [1, 2, 3], [10, 11, 9, 20, 21])
+    assert st.synced == 7
+
+
+def test_draft_proposer_reproduces_target_greedy(solo_refs):
+    """An identical draft engine drafting from the raw prompt must
+    reproduce the target's own greedy continuation — the sync/pre-feed/
+    draft dispatch chain is exact, not approximate."""
+    pr = DraftModelProposer(make_engine(2))
+    pr.sync(0, "r1", P1, [])
+    got = pr.propose({0: 4})
+    assert got[0] == solo_refs[tuple(P1)][:4]
+
+
+def test_draft_proposer_rejects_unsupported_engines():
+    with pytest.raises(ValueError, match="contiguous"):
+        DraftModelProposer(make_paged_engine())
+
+
+# -- engine layer: ragged slot verify ---------------------------------------
+
+def test_slot_verify_masked_kv_and_ride_along(solo_refs):
+    """One verify dispatch: row 0 carries 3 drafts (third wrong), row 1
+    rides with no proposal.  Row 0 accepts exactly 2 and the KV its
+    rejected draft wrote above the ceiling is dead — continuing both
+    rows from their ceilings is byte-identical to solo."""
+    eng = make_engine(2)
+    r1, r3 = solo_refs[tuple(P1)], solo_refs[tuple(P3)]
+    temps = np.zeros((2,), np.float32)
+    topps = np.full((2,), 0.9, np.float32)
+    # prefill both rows in one ragged dispatch
+    tokens = np.zeros((2, len(P2)), np.int32)
+    tokens[0, :len(P1)] = P1
+    tokens[1, :len(P3)] = P3
+    nv = np.array([len(P1), len(P3)], np.int32)
+    out = eng.slot_step(tokens, np.zeros((2,), np.int32), nv,
+                        temps_np=temps, topps_np=topps)
+    assert [int(out[-1, 0]), int(out[-1, 1])] == [r1[0], r3[0]]
+    # verify window: row 0 feeds its sample + drafts [r1[1], r1[2], X]
+    wrong = (r1[3] + 1) % CFG.vocab_size
+    vt = np.zeros((2, 4), np.int32)
+    vt[0] = [r1[0], r1[1], r1[2], wrong]
+    vt[1, 0] = r3[0]
+    pos = np.array([len(P1), len(P3)], np.int32)
+    preds, accepted = eng.slot_verify_async(
+        vt, pos, np.array([4, 1], np.int32),
+        temps_np=temps, topps_np=topps).wait()
+    assert int(accepted[0]) == 2 and int(accepted[1]) == 0
+    assert [int(x) for x in preds[0, :3]] == r1[1:4]  # 2 drafts + bonus
+    assert int(preds[1, 0]) == r3[1]                  # plain decode step
+    # continue from each row's accepted ceiling: the rejected draft's KV
+    # (and row 1's padding columns) must be invisible
+    ft = np.zeros((2, 1), np.int32)
+    ft[0, 0], ft[1, 0] = r1[3], r3[1]
+    cont = eng.slot_step(ft, np.array([len(P1) + 4, len(P3) + 2], np.int32),
+                         np.ones((2,), np.int32), temps_np=temps,
+                         topps_np=topps, steps=4)
+    assert [int(x) for x in cont[:, 0]] == r1[4:8]
+    assert [int(x) for x in cont[:, 1]] == r3[2:6]
+
+
+def test_slot_verify_validation():
+    eng = make_engine(2)
+    temps = np.zeros((2,), np.float32)
+    topps = np.full((2,), 0.9, np.float32)
+    with pytest.raises(ValueError, match="T >= 2"):
+        eng.slot_verify_async(np.zeros((2, 1), np.int32),
+                              np.zeros((2,), np.int32),
+                              np.ones((2,), np.int32),
+                              temps_np=temps, topps_np=topps)
+    with pytest.raises(ValueError, match="n_valid"):
+        eng.slot_verify_async(np.zeros((2, 3), np.int32),
+                              np.zeros((2,), np.int32),
+                              np.array([4, 1], np.int32),
+                              temps_np=temps, topps_np=topps)
+
+
+# -- scheduler: spec on/off byte parity -------------------------------------
+
+def _run_traffic(sched, solo_refs, *, eos_prompt=None, eos_at=3):
+    """Staggered ragged greedy traffic; returns {prompt: (tokens, finish)}.
+    ``eos_prompt`` additionally runs one request with an EOS id picked
+    from its own solo reference (stop-mid-verify coverage)."""
+    results = {}
+
+    def run(p, delay, max_new, eos_ids):
+        time.sleep(delay)
+        t = sched.submit(p, max_new, eos_ids=eos_ids)
+        results[tuple(p)] = (list(t.tokens()), t.finish)
+
+    jobs = [(p, d, 12, ()) for p, d in zip(PROMPTS, (0.0, 0.03, 0.2, 0.4))]
+    if eos_prompt is not None:
+        ref = solo_refs[tuple(eos_prompt)]
+        jobs.append((list(eos_prompt) + [13], 0.1, 25, (ref[eos_at],)))
+    threads = [threading.Thread(target=run, args=j) for j in jobs]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(120)
+    return results
+
+
+def _make_sched(mode, *, overlap=True):
+    eng = make_engine(4)
+    spec = make_proposer(
+        mode, eng,
+        draft_engine=make_engine(4) if mode == "draft" else None)
+    return SlotScheduler(eng, prefill_chunk=4, max_wait_ms=50.0,
+                         decode_burst=6, overlap=overlap,
+                         spec=spec, spec_k=4)
+
+
+@pytest.fixture(scope="module")
+def off_results(solo_refs):
+    """The --spec off baseline under the same traffic — what every
+    speculating run must byte-match."""
+    sched = _make_sched("off")
+    try:
+        return _run_traffic(sched, solo_refs, eos_prompt=P2)
+    finally:
+        sched.close()
+
+
+@pytest.mark.parametrize("overlap", [True, False],
+                         ids=["overlap", "no-overlap"])
+@pytest.mark.parametrize("mode", ["pld", "draft"])
+def test_spec_on_off_byte_parity(solo_refs, off_results, mode, overlap):
+    """THE acceptance: greedy output under ragged staggered traffic —
+    including an EOS that lands mid-verify-window — is byte-identical
+    with speculation on (both proposers) and off, pipeline on and off."""
+    sched = _make_sched(mode, overlap=overlap)
+    try:
+        outs = _run_traffic(sched, solo_refs, eos_prompt=P2)
+    finally:
+        sched.close()
+    assert outs == off_results
+    for p in PROMPTS:
+        got, finish = outs[tuple(p)]
+        assert got == solo_refs[tuple(p)][:12], p
+        assert finish == "length"
+    assert outs[tuple(list(P2) + [13])][1] == "stop"
+
+
+def test_spec_cancel_partial_prefix(solo_refs):
+    """Cancel mid-decode with speculation live: the partial output is a
+    prefix of the solo run — no token from a rejected or in-flight
+    draft ever leaks into the stream."""
+    sched = _make_sched("pld")
+    try:
+        with injected("engine.device_step=delay:0.02x100000"):
+            t = sched.submit(P1, 50)
+            got = []
+            for tok in t.tokens():
+                got.append(tok)
+                if len(got) >= 3:
+                    t.cancel("aborted")
+        assert t.finish == "aborted"
+        assert got == solo_refs[tuple(P1)][:len(got)]
+        assert 0 < len(got) < 50
+        assert sched._proposals == {}
+    finally:
+        sched.close()
+
+
+# -- acceptance ratio + exposition ------------------------------------------
+
+def test_identical_draft_engine_accepts_everything(solo_refs):
+    """An identical draft engine predicts the target exactly, so ~every
+    draft verifies: the per-ticket counts, global counters, gauge, and
+    flight record all agree, and the output is still byte-exact."""
+    eng = make_engine(2)
+    sched = SlotScheduler(eng, prefill_chunk=4, decode_burst=4,
+                          spec=DraftModelProposer(make_engine(2)), spec_k=4)
+    base = obs_metrics.snapshot_json()
+    try:
+        t = sched.submit(P1, 16)
+        assert list(t.tokens()) == solo_refs[tuple(P1)][:16]
+        assert t.finish == "length"
+    finally:
+        sched.close()
+    assert t.spec_proposed > 0
+    assert t.spec_accepted / t.spec_proposed >= 0.9, \
+        (t.spec_accepted, t.spec_proposed)
+    snap = obs_metrics.snapshot_json()
+    d_prop = snap["sched_spec_proposed"] - \
+        (base.get("sched_spec_proposed") or 0)
+    d_acc = (snap.get("sched_spec_accepted") or {}).get("draft", 0) - \
+        ((base.get("sched_spec_accepted") or {}).get("draft", 0))
+    assert d_prop >= t.spec_proposed and d_acc >= t.spec_accepted
+    assert 0.0 < snap["sched_spec_accept_ratio"] <= 1.0
+    prom = obs_metrics.render_prometheus()
+    for name in ("dllama_sched_spec_proposed_total",
+                 "dllama_sched_spec_accepted_total",
+                 'proposer="draft"',
+                 "dllama_sched_spec_accept_ratio"):
+        assert name in prom, name
+    rec = obs_flight.get(t.rid)
+    assert rec["spec_proposed"] == t.spec_proposed
+    assert rec["spec_accepted"] == t.spec_accepted
+    assert any(p["kind"] == "verify_burst" for p in rec["phases"])
+
+
+def test_pld_accept_ratio_on_repetitive_continuation():
+    """Prompt lookup on a repetitive continuation (zero-weight model:
+    constant argmax) must clear the accept-ratio floor — the n-gram
+    index really does turn repetition into accepted drafts."""
+    eng = make_engine(2, zero=True)
+    sched = SlotScheduler(eng, prefill_chunk=4, decode_burst=4,
+                          spec=PromptLookupProposer(vocab=CFG.vocab_size),
+                          spec_k=4)
+    try:
+        t = sched.submit([5, 0, 0], 24)
+        got = list(t.tokens())
+    finally:
+        sched.close()
+    assert got == [0] * 24  # zero weights: the solo run is constant too
+    assert t.spec_proposed > 0
+    assert t.spec_accepted / t.spec_proposed >= 0.9, \
+        (t.spec_accepted, t.spec_proposed)
+
+
+# -- flush points: preemption + hand-off ------------------------------------
+
+def test_spec_preempt_park_resume_byte_parity(solo_refs):
+    """Speculation coexists with QoS preemption: the victim's pending
+    drafts die at park, the resumed request is byte-identical, and the
+    page pool ends clean."""
+    eng = make_paged_engine(batch=2)
+    sched = SlotScheduler(eng, prefill_chunk=4, decode_burst=4,
+                          preempt=True, preempt_age_ms=0.0,
+                          prefix_reuse=False,
+                          spec=PromptLookupProposer(vocab=CFG.vocab_size),
+                          spec_k=4)
+    try:
+        done: dict = {}
+
+        def run(key, prompt, n, prio):
+            t = sched.submit(prompt, n, priority=prio)
+            done[key] = (list(t.tokens()), t.finish, t.preempt_count)
+
+        FAULTS.install("engine.device_step=delay:0.05x1000")
+        b1 = threading.Thread(target=run, args=(
+            "b1", P1, 30, PRIORITY_LEVELS["batch"]))
+        b2 = threading.Thread(target=run, args=(
+            "b2", P2, 30, PRIORITY_LEVELS["batch"]))
+        b1.start()
+        b2.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if sched.occupancy()["active"] == 2:
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError("batch never saturated the slots")
+        time.sleep(0.3)
+        it = threading.Thread(target=run, args=(
+            "it", P3, 6, PRIORITY_LEVELS["interactive"]))
+        it.start()
+        it.join(120)
+        FAULTS.clear()
+        b1.join(240)
+        b2.join(240)
+
+        assert done["it"][0] == solo_refs[tuple(P3)][:6]
+        assert any(done[k][2] >= 1 for k in ("b1", "b2")), \
+            f"no ticket recorded a preemption: {done}"
+        for k, p in (("b1", P1), ("b2", P2)):
+            toks, finish, _ = done[k]
+            assert finish == "length", (k, finish)
+            assert toks == solo_refs[tuple(p)][:30], \
+                f"{k} drifted after resume"
+        occ = sched.occupancy()
+        assert occ["active"] == 0 and occ["parked"] == 0, occ
+        assert occ["kv_pages_free"] == occ["kv_pages_total"], \
+            f"page leak: {occ}"
+        sched.pool.check()
+    finally:
+        sched.close()
+
+
+@pytest.fixture(scope="module")
+def paged_solo_ref():
+    eng = make_engine(1)
+    toks = [t for t, _ in eng.generate_stream(
+        P1, len(P1) + 30, temperature=0.0, chunk=5)]
+    return toks[len(P1):]
+
+
+def test_spec_handoff_export_flushes_drafts(paged_solo_ref):
+    """A hand-off export fired mid-decode with speculation live: every
+    DLREQ01 snapshot is taken with zero pending drafts (a record never
+    carries speculative state), and the export resumes byte-identically
+    on a peer that speculates too."""
+    def spec():
+        return PromptLookupProposer(vocab=CFG.vocab_size)
+
+    sa = SlotScheduler(make_paged_engine(), prefill_chunk=4,
+                       max_wait_ms=20.0, decode_burst=4,
+                       spec=spec(), spec_k=4)
+    sb = SlotScheduler(make_paged_engine(), prefill_chunk=4,
+                       max_wait_ms=20.0, decode_burst=4,
+                       spec=spec(), spec_k=4)
+    drafts_seen = []
+    real_export = sa._export_slot_locked
+
+    def spying_export(slot_idx):
+        drafts_seen.append(dict(sa._proposals))
+        return real_export(slot_idx)
+
+    sa._export_slot_locked = spying_export
+    try:
+        with injected("engine.device_step=delay:0.05x100000"):
+            t = sa.submit(P1, 30, temperature=0.0)
+            it = t.tokens()
+            consumed = [next(it) for _ in range(6)]
+            records = sa.handoff_export_all()
+        list(it)
+        assert t.finish == "handoff"
+        assert t.rid in records
+        assert drafts_seen and all(p == {} for p in drafts_seen), \
+            "an export snapshot saw pending drafts"
+        meta, _ = snapfmt.loads_request(records[t.rid])
+        replayed = [int(x) for x in meta["extra"]["completion"]]
+        assert replayed[:len(consumed)] == consumed
+        t2, _ = sb.import_request(records[t.rid])
+        resumed = list(t2.tokens())
+        assert t2.finish == "length"
+        assert replayed + resumed == paged_solo_ref
+    finally:
+        sa.close()
+        sb.close()
+
+
+# -- reject storm ------------------------------------------------------------
+
+def test_reject_storm_parity_and_graceful_ratio(solo_refs, off_results):
+    """The spec.propose=corrupt fault forces adversarial drafts for
+    every slot: proposals happen, near-none verify, and the served
+    bytes are still the model's own greedy output."""
+    sched = _make_sched("pld")
+    base = obs_metrics.snapshot_json().get("sched_spec_proposed") or 0
+    try:
+        with injected("spec.propose=corrupt"):
+            outs = _run_traffic(sched, solo_refs, eos_prompt=P2)
+    finally:
+        sched.close()
+    assert outs == off_results
+    proposed = (obs_metrics.snapshot_json().get("sched_spec_proposed")
+                or 0) - base
+    assert proposed > 0, "the storm never forced a proposal"

@@ -629,6 +629,71 @@ def drill_overlap_stall(model, tok):
     assert goodput_on > goodput_off, (goodput_on, goodput_off)
 
 
+def drill_spec_reject_storm(model, tok):
+    """An adversarial proposer (spec.propose=corrupt fault) swaps every
+    draft for tokens chosen to never match the model's argmax — the
+    speculative decoder's worst case.  The contract under the storm:
+    completion text stays byte-identical to --spec off (rejected drafts
+    are never emitted), the accept ratio collapses instead of erroring,
+    throughput stays in the same regime as speculation off (each verify
+    window still yields its one bonus token, so dispatch count does not
+    grow), and the paged pool shows no KV page leak after retirement."""
+    # paged pool (seq_len 64 / page 4, 2 slots) so the leak check is the
+    # page-pool accounting itself; --no-prefix-reuse keeps it exact
+    flags = ["--batch-slots", "2", "--kv-pages", "64", "--kv-page-size", "4",
+             "--no-prefix-reuse"]
+
+    def run_workload(spec_flags, faults=""):
+        s = Server(model, tok, faults=faults,
+                   extra_flags=flags + spec_flags)
+        try:
+            s.wait_ready()
+            texts = [None, None]
+
+            def run(i):
+                with post_to(s.base, "/v1/completions",
+                             {"prompt": "Once upon a time",
+                              "max_tokens": 24}) as r:
+                    texts[i] = json.loads(r.read())["choices"][0]["text"]
+
+            t0 = time.monotonic()
+            ths = [threading.Thread(target=run, args=(i,)) for i in (0, 1)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            elapsed = time.monotonic() - t0
+            snap = get(s.base, "/metrics")
+            occ = get(s.base, "/health")["scheduler"]
+            return texts, elapsed, snap, occ
+        finally:
+            s.stop()
+
+    texts_off, el_off, _, _ = run_workload(["--spec", "off"])
+    texts_storm, el_storm, snap, occ = run_workload(
+        ["--spec", "pld", "--spec-k", "4"],
+        faults="spec.propose=corrupt")
+    # byte parity: the storm's drafts all rejected, the emitted stream is
+    # still the model's own greedy argmax
+    assert all(texts_off) and texts_storm == texts_off, \
+        (texts_storm, texts_off)
+    # the storm actually stormed: drafts were forced and near-none stuck
+    proposed = snap.get("sched_spec_proposed", 0)
+    assert proposed > 0, "corrupt fault never forced a proposal"
+    ratio = snap.get("sched_spec_accept_ratio", 0.0)
+    assert ratio <= 0.2, f"adversarial drafts were accepted: {ratio}"
+    # graceful degradation: every verify window still yields its bonus
+    # token, so the dispatch count (and with it the wall) stays in the
+    # spec-off regime rather than collapsing; the additive slack absorbs
+    # the one-off verify-kernel compile the spec run pays
+    assert el_storm <= el_off * 1.75 + 20.0, (el_storm, el_off)
+    # no KV page leak: rejected-draft KV lives above the causal ceiling
+    # inside each request's own reservation, never in extra pages
+    assert occ["active"] == 0 and occ["queued"] == 0, occ
+    assert occ["kv_pages_free"] == occ["kv_pages_total"], \
+        f"page leak: {occ}"
+
+
 class Router:
     """The fleet router subprocess (python -m dllama_tpu.router) — no
     model load, so it is up in well under a second."""
@@ -803,6 +868,7 @@ DRILLS = {
     "priority_preempt": drill_priority_preempt,
     "slo_burn": drill_slo_burn,
     "overlap_stall": drill_overlap_stall,
+    "spec_reject_storm": drill_spec_reject_storm,
     "replica_failover": drill_replica_failover,
 }
 

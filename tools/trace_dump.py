@@ -98,6 +98,15 @@ def summarize(doc: dict) -> str:
         why = " ".join(f"{k}={v}" for k, v in preempts.most_common())
         lines.append(f"  preemptions: {why}; "
                      f"{parked_ms:.0f} ms total parked")
+    # speculative decoding story: sched_verify spans carry per-dispatch
+    # proposed/accepted draft counts (--spec; runtime/spec.py)
+    verifies = [e for e in spans if e["name"] == "sched_verify"]
+    proposed = sum(e["args"].get("proposed") or 0 for e in verifies)
+    accepted = sum(e["args"].get("accepted") or 0 for e in verifies)
+    if proposed:
+        lines.append(f"  speculation: {accepted}/{proposed} drafts "
+                     f"accepted ({accepted / proposed:.2f}) over "
+                     f"{len(verifies)} verify dispatches")
     return "\n".join(lines)
 
 
