@@ -307,6 +307,53 @@ def build_parser() -> argparse.ArgumentParser:
                    help="router mode: socket timeout per upstream request "
                         "(connect + per-read); a backend silent past this "
                         "is treated as failed")
+    # ---- crash tolerance (router resume + pod supervisor;
+    #      docs/ROBUSTNESS.md) ----
+    p.add_argument("--handoff-ttl", type=float, default=0.0,
+                   help="api server: seconds an exported DLREQ01 hand-off "
+                        "record waits unclaimed before it is garbage-"
+                        "collected (dllama_handoff_expired_total counts "
+                        "them); 0 = keep until claimed.  Bounds drain "
+                        "time when the router never comes to collect")
+    p.add_argument("--stall-timeout", type=float, default=0.0,
+                   help="router mode: seconds an open upstream stream may "
+                        "go silent before the replica is treated as dead "
+                        "(force-ejected) and the stream resumed elsewhere; "
+                        "catches wedged-but-connected replicas (SIGSTOP, "
+                        "device hang) that a connect timeout never sees.  "
+                        "Also bounds time-to-first-token, so set it above "
+                        "worst-case queue + prefill + compile.  0 = off")
+    p.add_argument("--checkpoint-interval", type=float, default=0.0,
+                   help="router mode: seconds between proactive DLREQ01 "
+                        "checkpoints of each in-flight greedy stream "
+                        "(GET /admin/checkpoint/<rid>); a crashed "
+                        "replica's streams then resume from the latest "
+                        "checkpoint instead of re-prefilling the whole "
+                        "prompt.  Requires replicas running --handoff. "
+                        "0 = off (resume falls back to deterministic "
+                        "re-run)")
+    p.add_argument("--resume-policy", choices=["auto", "never"],
+                   default="auto",
+                   help="router mode: default mid-stream crash behavior — "
+                        "auto resumes greedy streams on a peer (byte-"
+                        "identical; sampled streams always get the honest "
+                        "replica_lost), never disables resume fleet-wide. "
+                        "Per-request override: \"resume_policy\" body "
+                        "field")
+    p.add_argument("--supervise", action="store_true",
+                   help="serve-pod: run each replica as a child PROCESS "
+                        "under a supervisor that respawns it on crash "
+                        "(same port + device set, warm --snapshot-dir "
+                        "restore) and SIGKILLs+respawns it when /health "
+                        "hangs; crash-looping replicas are quarantined "
+                        "(--respawn-max/--respawn-window)")
+    p.add_argument("--respawn-max", type=int, default=5,
+                   help="serve-pod --supervise: deaths tolerated inside "
+                        "--respawn-window before a replica is quarantined "
+                        "instead of respawned")
+    p.add_argument("--respawn-window", type=float, default=30.0,
+                   help="serve-pod --supervise: sliding window (seconds) "
+                        "for the crash-loop counter")
     # ---- observability (docs/OBSERVABILITY.md) ----
     p.add_argument("--log-format", choices=["human", "json"], default=None,
                    help="log output format: human-readable lines or JSON "

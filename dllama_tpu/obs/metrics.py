@@ -747,3 +747,30 @@ ROUTER_REPLICA_LOST = REGISTRY.counter(
 ROUTER_BACKEND_LATENCY_S = REGISTRY.labeled_gauge(
     "router_backend_latency_s", ("backend",),
     "EWMA of health-probe round-trip latency per backend, seconds.")
+ROUTER_RESUMES = REGISTRY.labeled_counter(
+    "router_resumes", ("outcome",),
+    "Mid-stream resume attempts after a backend died with bytes "
+    "already forwarded, by outcome: checkpoint (resumed from a cached "
+    "DLREQ01 checkpoint), rerun (re-dispatched and prefix-verified on "
+    "a peer), mismatch (regenerated prefix diverged — honest "
+    "replica_lost), no_peer (no healthy peer could take it), failed "
+    "(the resume dispatch itself died).")
+ROUTER_STALLS = REGISTRY.counter(
+    "router_stalls",
+    "Streams cut by the router's stall watchdog (--stall-timeout): the "
+    "backend was connected but produced no bytes for the window — a "
+    "wedged replica treated as dead.")
+HANDOFF_EXPIRED = REGISTRY.counter(
+    "handoff_expired",
+    "Parked DLREQ01 export records dropped unclaimed after "
+    "--handoff-ttl (the router that triggered the drain never fetched "
+    "them).")
+POD_RESPAWNS = REGISTRY.labeled_counter(
+    "pod_respawns", ("replica", "reason"),
+    "serve-pod supervisor respawns of a replica process, by replica "
+    "index and reason (exit = process died, hung = health probes "
+    "stalled while the process lived).")
+POD_REPLICAS_UP = REGISTRY.gauge(
+    "pod_replicas_up",
+    "serve-pod supervised replica processes currently alive (a "
+    "quarantined crash-looper stays down and is not counted).")

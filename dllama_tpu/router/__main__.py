@@ -29,6 +29,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="max re-dispatches before giving up on a request")
     p.add_argument("--upstream-timeout", type=float, default=120.0,
                    help="socket timeout per upstream request (seconds)")
+    p.add_argument("--stall-timeout", type=float, default=0.0,
+                   help="seconds an open upstream stream may go silent "
+                        "before the watchdog treats the replica as dead "
+                        "(0 disables)")
+    p.add_argument("--checkpoint-interval", type=float, default=0.0,
+                   help="seconds between proactive DLREQ01 checkpoints "
+                        "of in-flight greedy streams (0 disables)")
+    p.add_argument("--resume-policy", choices=["auto", "never"],
+                   default="auto",
+                   help="default mid-stream crash behavior: auto resumes "
+                        "greedy streams on a peer, never keeps the "
+                        "honest replica_lost")
     p.add_argument("--log-format", choices=["human", "json"], default=None)
     p.add_argument("--log-level", default=None,
                    choices=["debug", "info", "warning", "error"])

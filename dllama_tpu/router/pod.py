@@ -22,11 +22,36 @@ mode partitions the local devices into ``--dp`` independent replicas of
 stops, then each replica's server shuts down.  Cross-replica request
 migration (DLREQ01) keeps working because the replicas expose the same
 ``/admin/export``/``/admin/import`` surface as external backends.
+
+``--supervise`` trades the shared weight load for crash isolation:
+each replica becomes a child **process** (``python -m
+dllama_tpu.server.api`` on a fixed loopback port) under a
+:class:`Supervisor` that respawns it on death — same port, same
+device set, warm ``--snapshot-dir`` restore — so the registry's
+hysteretic re-admission folds the replacement back into rotation with
+no operator action.  A replica that keeps dying (``--respawn-max``
+deaths inside ``--respawn-window`` seconds) is quarantined instead of
+respawned forever; a replica whose process is alive but whose
+``/health`` stops answering (device hang, wedged runtime) is killed
+and respawned as ``reason="hung"``.  See docs/ROBUSTNESS.md for the
+full crash matrix.
 """
 
 from __future__ import annotations
 
+import collections
+import http.client
+import os
+import re
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from ..obs import metrics as obs_metrics
 from ..obs.log import get_logger
+from ..runtime.faults import FAULTS
 
 _log = get_logger("router.pod")
 
@@ -68,7 +93,301 @@ def partition_devices(devices, dp: int, tp: int) -> list[list]:
     return [list(devices[r * tp:(r + 1) * tp]) for r in range(dp)]
 
 
+# -- supervised (crash-isolated) pod ------------------------------------
+
+def _free_port() -> int:
+    """A fixed port the OS just proved free: respawns rebind the SAME
+    address (ApiServer sets allow_reuse_address), so the registry's
+    hysteretic re-admission recovers the replacement with no
+    reconfiguration."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _child_env(base: dict, tp: int, ordinals: list[int]) -> dict:
+    """Device partition for one replica child, by environment:
+
+    * CPU hosts (``JAX_PLATFORMS=cpu`` — the test path) get
+      ``--xla_force_host_platform_device_count=<tp>`` so each child sees
+      exactly its tp virtual devices.
+    * TPU hosts get ``TPU_VISIBLE_DEVICES=<ordinals>`` (the libtpu
+      convention for multiple processes sharing one host's chips); each
+      child then runs single-process jax over its own chip subset.
+    """
+    env = dict(base)
+    if env.get("JAX_PLATFORMS", "").startswith("cpu"):
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                       "", env.get("XLA_FLAGS", "")).strip()
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_"
+                            f"count={tp}").strip()
+    else:
+        env["TPU_VISIBLE_DEVICES"] = ",".join(str(o) for o in ordinals)
+    return env
+
+
+def _replica_argv(args, port: int, snapdir: str | None) -> list[str]:
+    """Child command line: a standalone ``dllama-api`` replica on a fixed
+    loopback port, carrying the pod's serving flags.  The child uses
+    every device its environment makes visible (tp), so no partitioning
+    flags are forwarded."""
+    argv = [sys.executable, "-m", "dllama_tpu.server.api",
+            "--model", args.model, "--tokenizer", args.tokenizer,
+            "--host", "127.0.0.1", "--port", str(port),
+            "--temperature", str(args.temperature),
+            "--topp", str(args.topp),
+            "--chunk", str(args.chunk),
+            "--max-seq-len", str(args.max_seq_len),
+            "--max-pending", str(args.max_pending),
+            "--request-timeout", str(args.request_timeout),
+            "--io-timeout", str(args.io_timeout),
+            "--drain-grace", str(args.drain_grace),
+            "--buffer-float-type", args.buffer_float_type]
+    if args.batch_slots > 0:
+        argv += ["--batch-slots", str(args.batch_slots),
+                 "--kv-pages", str(args.kv_pages),
+                 "--kv-page-size", str(args.kv_page_size)]
+        if getattr(args, "no_prefix_reuse", False):
+            argv.append("--no-prefix-reuse")
+    if getattr(args, "handoff", False):
+        argv.append("--handoff")
+    if getattr(args, "handoff_ttl", 0.0):
+        argv += ["--handoff-ttl", str(args.handoff_ttl)]
+    if snapdir:
+        argv += ["--snapshot-dir", snapdir]
+    if getattr(args, "weights_float_type", None):
+        argv += ["--weights-float-type", args.weights_float_type]
+    if getattr(args, "kv_cache_dtype", None):
+        argv += ["--kv-cache-dtype", args.kv_cache_dtype]
+    if getattr(args, "log_format", None):
+        argv += ["--log-format", args.log_format]
+    return argv
+
+
+class _Replica:
+    """One supervised child: its spawn recipe plus crash-loop history."""
+
+    def __init__(self, idx: int, port: int, argv: list[str], env: dict):
+        self.idx = idx
+        self.port = port
+        self.argv = argv
+        self.env = env
+        self.proc: subprocess.Popen | None = None
+        self.deaths: collections.deque = collections.deque()
+        self.quarantined = False
+        self.ready = False       # answered /health since last spawn
+        self.hang_streak = 0
+
+
+class Supervisor:
+    """Keeps the pod's replica children alive.
+
+    Three failure shapes, three answers (docs/ROBUSTNESS.md):
+
+    * **death** (any exit, SIGKILL included) — respawn on the same port
+      and device set; ``--snapshot-dir`` makes it a warm start and the
+      registry re-admits it after ``readmit_after`` healthy probes.
+    * **crash loop** — more than ``respawn_max`` deaths inside
+      ``respawn_window`` seconds quarantines the replica (structured
+      ``pod_replica_quarantined`` log, no further respawns): a
+      deterministic crasher respawned forever would grind the fleet
+      with prefill churn.
+    * **hang** — process alive, ``/health`` silent for ``hang_probes``
+      consecutive probes: SIGKILL then respawn (``reason="hung"``).
+      Hang detection only arms after the child's FIRST healthy answer
+      since spawn, so a model still loading or compiling is never shot.
+
+    The ``pod.respawn`` fault point fires before each respawn; a raising
+    fault counts as another death in the crash-loop window.
+    """
+
+    def __init__(self, replicas: list[_Replica], *, respawn_max: int = 5,
+                 respawn_window: float = 30.0, hang_probes: int = 3,
+                 poll_interval: float = 1.0, probe_timeout: float = 2.0):
+        self.replicas = replicas
+        self.respawn_max = max(1, int(respawn_max))
+        self.respawn_window = float(respawn_window)
+        self.hang_probes = max(1, int(hang_probes))
+        self.poll_interval = float(poll_interval)
+        self.probe_timeout = float(probe_timeout)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def spawn(self, rep: _Replica) -> None:
+        rep.proc = subprocess.Popen(rep.argv, env=rep.env)
+        rep.ready = False
+        rep.hang_streak = 0
+        _log.info("pod_replica_spawned", extra={
+            "replica": rep.idx, "port": rep.port, "pid": rep.proc.pid})
+
+    def start(self) -> None:
+        for rep in self.replicas:
+            self.spawn(rep)
+        obs_metrics.POD_REPLICAS_UP.set(len(self.replicas))
+        self._thread = threading.Thread(target=self._watch,
+                                        name="pod-supervisor", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(
+                timeout=self.poll_interval + self.probe_timeout + 2.0)
+        for rep in self.replicas:
+            if rep.proc is not None and rep.proc.poll() is None:
+                rep.proc.terminate()
+        deadline = time.monotonic() + 10.0
+        for rep in self.replicas:
+            if rep.proc is None:
+                continue
+            try:
+                rep.proc.wait(timeout=max(0.1,
+                                          deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                rep.proc.kill()
+                rep.proc.wait(timeout=5.0)
+
+    # -- watch loop -----------------------------------------------------
+    def _probe(self, rep: _Replica) -> bool:
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", rep.port, timeout=self.probe_timeout)
+            try:
+                conn.request("GET", "/health")
+                return conn.getresponse().status == 200
+            finally:
+                conn.close()
+        except OSError:
+            return False
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            for rep in self.replicas:
+                if rep.quarantined:
+                    continue
+                if rep.proc is None:
+                    # a previous respawn attempt itself failed: treat
+                    # every poll without a process as another death so
+                    # the crash-loop window still converges
+                    self._died(rep, "respawn")
+                elif rep.proc.poll() is not None:
+                    self._died(rep, "exit")
+                elif self._probe(rep):
+                    rep.ready = True
+                    rep.hang_streak = 0
+                elif rep.ready:
+                    rep.hang_streak += 1
+                    if rep.hang_streak >= self.hang_probes:
+                        _log.warning("pod_replica_hung", extra={
+                            "replica": rep.idx, "pid": rep.proc.pid,
+                            "failed_probes": rep.hang_streak})
+                        rep.proc.kill()  # wedged, not draining: no grace
+                        try:
+                            rep.proc.wait(timeout=10.0)
+                        except subprocess.TimeoutExpired:
+                            pass
+                        self._died(rep, "hung")
+            obs_metrics.POD_REPLICAS_UP.set(self.replicas_up())
+
+    def replicas_up(self) -> int:
+        return sum(1 for rep in self.replicas
+                   if not rep.quarantined and rep.proc is not None
+                   and rep.proc.poll() is None)
+
+    def _died(self, rep: _Replica, reason: str) -> None:
+        now = time.monotonic()
+        rep.deaths.append(now)
+        while rep.deaths and now - rep.deaths[0] > self.respawn_window:
+            rep.deaths.popleft()
+        _log.warning("pod_replica_died", extra={
+            "replica": rep.idx, "reason": reason,
+            "returncode": rep.proc.returncode if rep.proc else None,
+            "deaths_in_window": len(rep.deaths)})
+        if len(rep.deaths) > self.respawn_max:
+            rep.quarantined = True
+            rep.proc = None
+            _log.error("pod_replica_quarantined", extra={
+                "replica": rep.idx, "reason": reason,
+                "deaths": len(rep.deaths),
+                "window_s": self.respawn_window})
+            return
+        try:
+            FAULTS.fire("pod.respawn")
+            self.spawn(rep)
+        except Exception as e:  # noqa: BLE001 — injected or exec failure
+            _log.error("pod_respawn_failed", extra={
+                "replica": rep.idx, "error": str(e)})
+            rep.proc = None
+            return
+        obs_metrics.POD_RESPAWNS.inc(str(rep.idx), reason)
+
+
+def supervise_main(args) -> None:
+    """``serve-pod --supervise``: subprocess replicas under a
+    :class:`Supervisor`, fleet router in this (jax-free) parent.
+
+    The parent deliberately never imports jax: initializing a backend
+    here would hold the very devices the children need.  The cost of
+    isolation is dp separate weight loads (children cannot share a
+    host-side read); the payoff is that a replica crash takes down ONE
+    process and the supervisor puts it back."""
+    if not args.model or not args.tokenizer:
+        raise SystemExit("--model and --tokenizer are required for "
+                         "serve-pod")
+    from .registry import Registry
+    from .service import RouterState
+    from .service import serve as router_serve
+
+    dp = max(args.dp, 1)
+    # device count is unknowable without initializing jax; an explicit
+    # --workers tpu:N names the per-replica degree, default is 1
+    tp = parse_pod_tp(args.workers, 0, dp) if args.workers else 1
+    replicas = []
+    for r in range(dp):
+        port = _free_port()
+        snapdir = None
+        if getattr(args, "snapshot_dir", None):
+            snapdir = os.path.join(args.snapshot_dir, f"replica{r}")
+            os.makedirs(snapdir, exist_ok=True)
+        ordinals = list(range(r * tp, (r + 1) * tp))
+        replicas.append(_Replica(
+            r, port, _replica_argv(args, port, snapdir),
+            _child_env(os.environ, tp, ordinals)))
+
+    sup = Supervisor(
+        replicas,
+        respawn_max=getattr(args, "respawn_max", 5),
+        respawn_window=getattr(args, "respawn_window", 30.0),
+        poll_interval=min(1.0, float(args.probe_interval)),
+        probe_timeout=min(float(args.upstream_timeout), 2.0))
+    sup.start()
+    try:
+        registry = Registry(
+            [f"127.0.0.1:{rep.port}" for rep in replicas],
+            probe_interval=args.probe_interval,
+            eject_after=args.eject_after,
+            readmit_after=args.readmit_after,
+            probe_timeout=min(float(args.upstream_timeout), 5.0))
+        rstate = RouterState(
+            registry, retries=args.router_retries,
+            upstream_timeout=args.upstream_timeout,
+            stall_timeout=getattr(args, "stall_timeout", 0.0),
+            checkpoint_interval=getattr(args, "checkpoint_interval", 0.0),
+            resume_policy=getattr(args, "resume_policy", "auto"))
+        print(f"💡 serve-pod: supervising {dp} replica process(es) × "
+              f"tp={tp}; router on :{args.port}")
+        router_serve(rstate, host=args.host, port=args.port)
+    finally:
+        sup.stop()
+
+
 def main(args) -> None:
+    if getattr(args, "supervise", False):
+        supervise_main(args)
+        return
+
     import jax
     import jax.numpy as jnp
 
@@ -163,7 +482,8 @@ def main(args) -> None:
                 request_timeout=args.request_timeout,
                 io_timeout=args.io_timeout, drain_grace=args.drain_grace,
                 scheduler=scheduler,
-                handoff=getattr(args, "handoff", False))
+                handoff=getattr(args, "handoff", False),
+                handoff_ttl=getattr(args, "handoff_ttl", 0.0))
             # loopback + ephemeral port: the OS picks, so dp replicas can
             # never collide with each other or the public port
             server = api.serve(state, host="127.0.0.1", port=0,
@@ -180,8 +500,12 @@ def main(args) -> None:
             eject_after=args.eject_after,
             readmit_after=args.readmit_after,
             probe_timeout=min(float(args.upstream_timeout), 5.0))
-        rstate = RouterState(registry, retries=args.router_retries,
-                             upstream_timeout=args.upstream_timeout)
+        rstate = RouterState(
+            registry, retries=args.router_retries,
+            upstream_timeout=args.upstream_timeout,
+            stall_timeout=getattr(args, "stall_timeout", 0.0),
+            checkpoint_interval=getattr(args, "checkpoint_interval", 0.0),
+            resume_policy=getattr(args, "resume_policy", "auto"))
         print(f"💡 serve-pod: {dp} replica(s) × tp={tp} over "
               f"{dp * tp}/{len(devices)} devices; router on :{args.port}")
         router_serve(rstate, host=args.host, port=args.port)

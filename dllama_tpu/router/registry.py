@@ -57,7 +57,19 @@ class Backend:
         self.ejected = False
         self.last_health: dict | None = None
         self.last_probe_s: float | None = None  # EWMA probe RTT
+        self.rtt_floor: float | None = None     # best EWMA ever seen
         self.in_flight = 0                # router-side active dispatches
+
+    def rtt_degraded(self) -> bool:
+        """Probe RTT has blown 10× past this backend's own baseline —
+        the pre-hang signature (GC death spiral, device queue backing
+        up).  The floor is clamped to 1 ms so a sub-millisecond loopback
+        baseline cannot make normal jitter read as degradation, and the
+        threshold to 50 ms so WAN-ish probes need a real excursion."""
+        if self.last_probe_s is None or self.rtt_floor is None:
+            return False
+        return self.last_probe_s > max(10.0 * max(self.rtt_floor, 1e-3),
+                                       0.05)
 
     def summary(self) -> dict:
         h = self.last_health or {}
@@ -69,6 +81,7 @@ class Backend:
             "ok_streak": self.ok_streak,
             "in_flight": self.in_flight,
             "probe_s": self.last_probe_s,
+            "rtt_degraded": self.rtt_degraded(),
             "capacity": h.get("capacity"),
             "degraded": h.get("degraded"),
             "slo": (h.get("slo") or {}).get("status") if h.get("slo")
@@ -119,6 +132,8 @@ class Registry:
             # tracking a genuinely slowing replica within a few probes
             b.last_probe_s = rtt if b.last_probe_s is None \
                 else 0.7 * b.last_probe_s + 0.3 * rtt
+            b.rtt_floor = b.last_probe_s if b.rtt_floor is None \
+                else min(b.rtt_floor, b.last_probe_s)
             obs_metrics.ROUTER_BACKEND_LATENCY_S.set(
                 b.addr, round(b.last_probe_s, 6))
             b.fail_streak = 0
@@ -165,6 +180,21 @@ class Registry:
         with self._lock:
             self._fail_locked(b, why)
 
+    def force_eject(self, b: Backend, why: str) -> None:
+        """Immediate ejection, bypassing the failure-streak hysteresis —
+        for signals where waiting out ``eject_after`` probes would keep
+        feeding streams to a replica known to be wedged (the router's
+        stream-stall watchdog).  Re-admission stays hysteretic: the
+        replica earns its way back with ``readmit_after`` healthy
+        probes like any ejected backend."""
+        with self._lock:
+            b.ok_streak = 0
+            b.fail_streak = max(b.fail_streak, self.eject_after)
+            if not b.ejected:
+                b.ejected = True
+                obs_metrics.ROUTER_EJECTIONS.inc(b.addr)
+                _log.warning("backend %s EJECTED (%s)", b.addr, why)
+
     def record_success(self, b: Backend) -> None:
         # a served request proves liveness as well as a probe does, but
         # re-admission stays probe-driven (readmit_after applies to
@@ -194,6 +224,11 @@ class Registry:
             # tiebreak only: a page is worth far less than a slot
             score += min(float(free_pages), 1e5) * 1e-6
         if h.get("degraded"):
+            score -= _PENALTY
+        if b.rtt_degraded():
+            # pre-hang signature: probes still answer (no failure streak
+            # to eject on) but 10× slower than this backend's own
+            # baseline — steer traffic away BEFORE the full stall
             score -= _PENALTY
         if (h.get("slo") or {}).get("status") == "violating" \
                 and not interactive:

@@ -10,9 +10,17 @@ no tokenizer, no jax) that fronts N ``dllama-api`` replicas:
   ``X-Dllama-Hop`` (this router's instance id) on the upstream hop.
 * A backend that fails **before any response bytes were forwarded** is
   retried on another replica — the request was idempotent up to that
-  point.  A backend that dies **mid-stream** ends the client's stream
-  with a final ``finish_reason="replica_lost"`` chunk: the truncation
-  is flagged, never silent.
+  point.  A backend that dies **mid-stream** is transparently resumed
+  on a peer when the request is greedy (``temperature: 0``) and
+  streaming: tier 1 imports the latest proactive DLREQ01 checkpoint
+  (``--checkpoint-interval``), tier 2 replays the request and swallows
+  the regenerated prefix, verified char-by-char — the client's bytes
+  are identical to an uninterrupted run.  Sampled requests (no
+  determinism to lean on) and ``resume_policy: "never"`` keep the
+  honest ``finish_reason="replica_lost"`` chunk: the truncation is
+  flagged, never silent.  A stream that goes *silent* without the
+  socket dying (``--stall-timeout``) is treated the same way, and the
+  wedged replica is force-ejected.
 * A replica that begins draining finishes each in-flight scheduler
   request with the internal ``finish_reason="handoff"``.  The router
   intercepts it (never forwarded), fetches the request's DLREQ01 record
@@ -43,6 +51,7 @@ from urllib.parse import parse_qs
 
 from ..obs import flight as obs_flight, metrics as obs_metrics
 from ..obs.log import get_logger, set_request_id
+from ..runtime.snapshot import RecordStore
 from ..server.backoff import jittered_retry_after
 from .registry import Backend, Registry
 
@@ -81,11 +90,37 @@ def _evt_fields(evt: dict, chat: bool) -> tuple[str, str | None]:
 class RouterState:
     def __init__(self, registry: Registry, *, retries: int = 2,
                  upstream_timeout: float = 120.0,
-                 model_name: str = "fleet"):
+                 model_name: str = "fleet",
+                 stall_timeout: float = 0.0,
+                 checkpoint_interval: float = 0.0,
+                 resume_policy: str = "auto",
+                 resume_window: float = 10.0):
         self.registry = registry
         self.retries = max(0, int(retries))
         self.upstream_timeout = float(upstream_timeout)
         self.model_name = model_name
+        # ---- crash tolerance (mid-stream resume; docs/ROBUSTNESS.md) --
+        # stall_timeout: per-read socket timeout on an open upstream
+        # stream — a connected-but-silent replica (SIGSTOP, device hang)
+        # is treated as dead after this window.  checkpoint_interval:
+        # how often the background poller snapshots each greedy stream's
+        # slot via GET /admin/checkpoint/<rid>; 0 disables.  Cached
+        # checkpoints expire after 4 intervals (min 30 s) — a crashed
+        # request's record must not outlive its usefulness.
+        self.stall_timeout = max(0.0, float(stall_timeout))
+        self.checkpoint_interval = max(0.0, float(checkpoint_interval))
+        self.resume_policy = resume_policy \
+            if resume_policy in ("auto", "never") else "auto"
+        # resume_window: how long a resume keeps trying before the
+        # honest replica_lost — the natural peer is often seconds away
+        # (mid-readmission after a respawn, or momentarily saturated),
+        # and a resume that gives up in milliseconds wastes the ladder
+        self.resume_window = max(0.0, float(resume_window))
+        self.checkpoints = RecordStore(
+            ttl=max(4.0 * self.checkpoint_interval, 30.0)
+            if self.checkpoint_interval > 0 else 0.0)
+        self._streams_lock = threading.Lock()
+        self._streams: dict[str, Backend] = {}
         # hop id: correlates every replica-side flight record this
         # router created (X-Dllama-Hop) with this process
         self.hop = f"router-{uuid.uuid4().hex[:8]}"
@@ -94,6 +129,20 @@ class RouterState:
     def connect(self, b: Backend) -> http.client.HTTPConnection:
         return http.client.HTTPConnection(b.host, b.port,
                                           timeout=self.upstream_timeout)
+
+    # -- checkpoint targets (greedy in-flight streams) ------------------
+    def track_stream(self, rid: str, b: Backend) -> None:
+        with self._streams_lock:
+            self._streams[rid] = b
+
+    def untrack_stream(self, rid: str) -> None:
+        with self._streams_lock:
+            self._streams.pop(rid, None)
+        self.checkpoints.discard(rid)
+
+    def checkpoint_targets(self) -> list[tuple[str, Backend]]:
+        with self._streams_lock:
+            return list(self._streams.items())
 
     def health(self) -> dict:
         snap = self.registry.snapshot()
@@ -113,6 +162,8 @@ class _Ctx:
 
     def __init__(self):
         self.chars = 0            # completion-text chars forwarded
+        self.text = ""            # the forwarded completion text itself
+        #                           (the byte-parity oracle for resume)
         self.headers_sent = False  # client SSE headers committed
         self.client_gone = False
         self.finished = False      # a finish_reason reached the client
@@ -210,6 +261,7 @@ def make_handler(state: RouterState):
                                  "logprobs": None}]}).encode())
             if text:
                 ctx.chars += len(text)
+                ctx.text += text
             if finish is not None:
                 ctx.finished = True
 
@@ -313,6 +365,24 @@ def make_handler(state: RouterState):
                 or self.headers.get("X-Dllama-Priority")
             prio = str(prio).strip().lower() if prio is not None else None
             self._prio = prio if prio in _PRIORITIES else None
+            # resume_policy is a ROUTER contract, never forwarded: with
+            # "auto" (the default) a greedy stream whose replica dies
+            # mid-decode is transparently resumed on a peer; "never"
+            # keeps today's honest finish_reason="replica_lost"
+            resume = body.pop("resume_policy", None)
+            if resume is not None:
+                resume = str(resume).strip().lower()
+                if resume not in ("auto", "never"):
+                    self._json(400, {
+                        "error": f"unknown resume_policy {resume!r}; "
+                                 "expected auto|never"})
+                    return
+                raw = json.dumps(body).encode()
+            self._resume_policy = resume
+            # the byte-parity resume guarantee only exists for greedy
+            # decode (the house invariant); sampled requests are never
+            # silently regenerated
+            self._greedy = body.get("temperature") == 0
             self._proxy_completion(path, raw, body)
 
         def _proxy_completion(self, path: str, raw: bytes,
@@ -325,37 +395,50 @@ def make_handler(state: RouterState):
             ctx = _Ctx()
             tried: list[Backend] = []
             retries_left = state.retries
-            while True:
-                b = state.registry.pick(exclude=tried,
-                                        priority=self._prio)
-                if b is None:
-                    self._out_of_backends(ctx, chat, rid)
-                    return
-                tried.append(b)
-                obs_flight.phase(rid, "dispatch", backend=b.addr)
-                obs_metrics.ROUTER_DISPATCH.inc(b.addr)
-                state.registry.acquire(b)
-                try:
-                    verdict = self._attempt(b, path, raw, chat, stream,
-                                            rid, ctx)
-                finally:
-                    state.registry.release(b)
-                if verdict == "done":
-                    obs_flight.retire(rid, reason="done", backend=b.addr)
-                    return
-                if verdict == "busy":
-                    continue  # not a failure; just try the next replica
-                if verdict == "lost":
-                    self._finish_replica_lost(ctx, chat, rid)
-                    return
-                # verdict == "retry": nothing client-visible happened —
-                # the request is still idempotent
-                if retries_left <= 0:
-                    self._out_of_backends(ctx, chat, rid)
-                    return
-                retries_left -= 1
-                obs_metrics.ROUTER_RETRIES.inc()
-                obs_flight.phase(rid, "retry", backend=b.addr)
+            # the checkpoint poller only follows greedy streams: those
+            # are the only ones the resume ladder may replay, so
+            # checkpointing anything else would be wasted /admin work
+            track = (stream and getattr(self, "_greedy", False)
+                     and state.checkpoint_interval > 0)
+            try:
+                while True:
+                    b = state.registry.pick(exclude=tried,
+                                            priority=self._prio)
+                    if b is None:
+                        self._out_of_backends(ctx, chat, rid)
+                        return
+                    tried.append(b)
+                    obs_flight.phase(rid, "dispatch", backend=b.addr)
+                    obs_metrics.ROUTER_DISPATCH.inc(b.addr)
+                    if track:
+                        state.track_stream(rid, b)
+                    state.registry.acquire(b)
+                    try:
+                        verdict = self._attempt(b, path, raw, chat,
+                                                stream, rid, ctx)
+                    finally:
+                        state.registry.release(b)
+                    if verdict == "done":
+                        obs_flight.retire(rid, reason="done",
+                                          backend=b.addr)
+                        return
+                    if verdict == "busy":
+                        continue  # not a failure; try the next replica
+                    if verdict == "lost":
+                        self._handle_lost(b, path, raw, chat, stream,
+                                          rid, ctx, tried)
+                        return
+                    # verdict == "retry": nothing client-visible
+                    # happened — the request is still idempotent
+                    if retries_left <= 0:
+                        self._out_of_backends(ctx, chat, rid)
+                        return
+                    retries_left -= 1
+                    obs_metrics.ROUTER_RETRIES.inc()
+                    obs_flight.phase(rid, "retry", backend=b.addr)
+            finally:
+                if track:
+                    state.untrack_stream(rid)
 
         def _out_of_backends(self, ctx: _Ctx, chat: bool,
                              rid: str) -> None:
@@ -388,6 +471,199 @@ def make_handler(state: RouterState):
                                  "finish_reason": "replica_lost"})
             obs_flight.retire(rid, reason="replica_lost")
 
+        # -- mid-stream resume (crash tolerance) -----------------------
+        def _handle_lost(self, dead: Backend, path: str, raw: bytes,
+                         chat: bool, stream: bool, rid: str, ctx: _Ctx,
+                         tried: list[Backend]) -> None:
+            """A backend died after forwarding content.  For a greedy
+            stream under ``resume_policy=auto`` the router resumes on a
+            peer instead of truncating: tier 1 imports the most recent
+            DLREQ01 checkpoint (KV intact — no re-prefill), tier 2
+            replays the original request and swallows the regenerated
+            prefix (greedy decode is deterministic, so the peer
+            re-produces byte-identical text — verified char by char).
+            Anything non-greedy, non-stream, or opted out keeps the
+            honest ``finish_reason="replica_lost"``.
+            """
+            policy = getattr(self, "_resume_policy", None) \
+                or state.resume_policy
+            resumable = (stream and ctx.headers_sent
+                         and not ctx.client_gone and not ctx.finished
+                         and policy == "auto"
+                         and getattr(self, "_greedy", False))
+            if not resumable:
+                self._finish_replica_lost(ctx, chat, rid)
+                return
+            obs_flight.phase(rid, "resume", backend=dead.addr,
+                             chars=ctx.chars)
+            record = state.checkpoints.pop(rid)
+            if record is not None:
+                got = self._offer_record(record, ctx.chars,
+                                         exclude=set(tried))
+                if got is not None:
+                    peer, resp, conn = got
+                    obs_flight.phase(rid, "resume_checkpoint",
+                                     backend=peer.addr)
+                    try:
+                        verdict = self._relay_continuation(
+                            peer, resp, chat, rid, ctx)
+                    finally:
+                        conn.close()
+                    if verdict == "done":
+                        obs_metrics.ROUTER_RESUMES.inc("checkpoint")
+                        obs_flight.retire(rid, reason="resumed",
+                                          backend=peer.addr)
+                        return
+                    # the continuation died too — fall through to the
+                    # re-run tier; ctx.text still covers every char the
+                    # client has seen, so the prefix oracle holds
+            verdict = self._resume_rerun(path, raw, chat, rid, ctx,
+                                         tried)
+            if verdict == "done":
+                obs_metrics.ROUTER_RESUMES.inc("rerun")
+                obs_flight.retire(rid, reason="resumed")
+                return
+            obs_metrics.ROUTER_RESUMES.inc(verdict)
+            self._finish_replica_lost(ctx, chat, rid)
+
+        def _resume_rerun(self, path: str, raw: bytes, chat: bool,
+                          rid: str, ctx: _Ctx,
+                          tried: list[Backend]) -> str:
+            """Tier-2 resume: replay the ORIGINAL request on up to
+            ``retries+1`` fresh peers per round, for up to
+            ``resume_window`` seconds.  Returns ``done`` on a spliced
+            finish, ``mismatch`` on prefix divergence, ``failed`` on a
+            replica-side error event, ``no_peer`` when the window
+            closes with the fleet still exhausted.
+
+            The window (not a single pass) is the point: right after a
+            crash the best peer is often seconds away — the victim's
+            replacement mid-readmission, or the survivor riding out a
+            saturation burst (429 → ``retry``) — and truncating the
+            client over a transient costs the whole resume.  Round one
+            excludes the backends the request already died on; later
+            rounds trust the registry's live ejection state instead, so
+            a respawned victim becomes eligible the moment it is
+            re-admitted."""
+            deadline = time.monotonic() + state.resume_window
+            first_round = True
+            while True:
+                round_tried = list(tried) if first_round else []
+                for _ in range(state.retries + 1):
+                    b = state.registry.pick(
+                        exclude=round_tried,
+                        priority=getattr(self, "_prio", None))
+                    if b is None:
+                        break
+                    round_tried.append(b)
+                    obs_flight.phase(rid, "resume_rerun",
+                                     backend=b.addr)
+                    state.registry.acquire(b)
+                    try:
+                        verdict = self._rerun_attempt(b, path, raw,
+                                                      chat, rid, ctx)
+                    finally:
+                        state.registry.release(b)
+                    if verdict != "retry":
+                        return verdict
+                first_round = False
+                if time.monotonic() >= deadline:
+                    return "no_peer"
+                time.sleep(0.5)
+
+        def _rerun_attempt(self, b: Backend, path: str, raw: bytes,
+                           chat: bool, rid: str, ctx: _Ctx) -> str:
+            """One re-run on one peer: swallow the regenerated prefix
+            (comparing against ``ctx.text`` — any divergence aborts the
+            splice), then forward the remainder into the client's open
+            stream as if it never broke."""
+            try:
+                conn = state.connect(b)
+            except OSError:
+                state.registry.record_failure(b)
+                return "retry"
+            try:
+                try:
+                    headers = {"Content-Type": "application/json",
+                               "X-Request-Id": rid,
+                               "X-Dllama-Hop": state.hop}
+                    if getattr(self, "_prio", None):
+                        headers["X-Dllama-Priority"] = self._prio
+                    conn.request("POST", path, raw, headers=headers)
+                    if state.stall_timeout > 0 and conn.sock is not None:
+                        # armed before getresponse: a close-delimited
+                        # response nulls conn.sock (see _attempt)
+                        conn.sock.settimeout(state.stall_timeout)
+                    resp = conn.getresponse()
+                except OSError:
+                    state.registry.record_failure(b)
+                    return "retry"
+                if resp.status != 200 or "text/event-stream" not in (
+                        resp.getheader("Content-Type") or ""):
+                    resp.read()
+                    return "retry"
+                prefix = ctx.text
+                pos = 0  # chars of the prefix re-verified so far
+                try:
+                    for payload in _iter_sse(resp):
+                        if payload == b"[DONE]":
+                            state.registry.record_success(b)
+                            if ctx.finished:
+                                self._client_event(ctx, b"[DONE]")
+                                return "done"
+                            return "retry"
+                        try:
+                            evt = json.loads(payload)
+                        except ValueError:
+                            continue
+                        if "error" in evt:
+                            # deterministic server-side error: a third
+                            # peer would hit it too — stop here
+                            return "failed"
+                        text, finish = _evt_fields(evt, chat)
+                        if finish == "handoff":
+                            # the peer began draining mid-re-run: chase
+                            # its record; emitted_chars=ctx.chars makes
+                            # the importer absorb whatever prefix was
+                            # still unregenerated
+                            got = self._handoff(b, rid, chat, ctx,
+                                                stream=True)
+                            return "done" if got == "done" else "failed"
+                        if pos < len(prefix):
+                            k = min(len(text), len(prefix) - pos)
+                            if text[:k] != prefix[pos:pos + k]:
+                                _log.warning(
+                                    "resume prefix mismatch at char %d "
+                                    "on %s (request %s): re-run is not "
+                                    "byte-identical; aborting splice",
+                                    pos, b.addr, rid)
+                                return "mismatch"
+                            pos += k
+                            text = text[k:]
+                        if finish is not None and pos < len(prefix):
+                            # finished before regenerating everything
+                            # the client already saw — divergence
+                            return "mismatch"
+                        if text or finish is not None:
+                            self._client_chunk(ctx, chat, text, finish)
+                            if ctx.client_gone:
+                                return "done"
+                except TimeoutError:
+                    obs_metrics.ROUTER_STALLS.inc()
+                    state.registry.force_eject(
+                        b, "stream stall (--stall-timeout)")
+                except (OSError, http.client.HTTPException):
+                    state.registry.record_failure(b)
+                if ctx.finished:
+                    self._client_event(ctx, b"[DONE]")
+                    return "done"
+                # the re-run died mid-way; ctx.text grew to cover all
+                # forwarded chars, so another peer can pick up the
+                # (longer) prefix — still a clean retry
+                return "retry"
+            finally:
+                conn.close()
+
         def _attempt(self, b: Backend, path: str, raw: bytes, chat: bool,
                      stream: bool, rid: str, ctx: _Ctx) -> str:
             """One dispatch to one backend.  Returns a verdict:
@@ -407,6 +683,18 @@ def make_handler(state: RouterState):
                     if getattr(self, "_prio", None):
                         headers["X-Dllama-Priority"] = self._prio
                     conn.request("POST", path, raw, headers=headers)
+                    if stream and state.stall_timeout > 0 \
+                            and conn.sock is not None:
+                        # per-read deadline on the stream: a replica that
+                        # is connected but silent (SIGSTOP, device hang)
+                        # trips TimeoutError in _relay_stream and is
+                        # treated as dead.  Armed BEFORE getresponse —
+                        # a close-delimited response nulls conn.sock
+                        # when the headers land — so it also bounds
+                        # time-to-first-token (queue + prefill + compile
+                        # all count) and the flag must exceed worst-case
+                        # cold-start; see docs/ROBUSTNESS.md.
+                        conn.sock.settimeout(state.stall_timeout)
                     resp = conn.getresponse()
                 except OSError:
                     state.registry.record_failure(b)
@@ -473,8 +761,22 @@ def make_handler(state: RouterState):
                     if not self._client_event(ctx, payload):
                         return "done"  # client gone; nothing to salvage
                     ctx.chars += len(text)
+                    ctx.text += text
                     if finish is not None:
                         ctx.finished = True
+            except TimeoutError:
+                # TimeoutError precedes the OSError catch (it IS an
+                # OSError since 3.10): a stalled read is a wedged-but-
+                # connected replica, which a failure streak would never
+                # eject (its /health may still answer) — force it out.
+                obs_metrics.ROUTER_STALLS.inc()
+                state.registry.force_eject(
+                    b, "stream stall (--stall-timeout)")
+                obs_flight.phase(rid, "stream_stall", backend=b.addr)
+                if ctx.finished:
+                    self._client_event(ctx, b"[DONE]")
+                    return "done"
+                return "retry" if ctx.chars == 0 else "lost"
             except (OSError, http.client.HTTPException):
                 pass
             # upstream socket died (or closed without [DONE])
@@ -704,11 +1006,50 @@ def make_handler(state: RouterState):
     return Handler
 
 
+def _checkpoint_loop(state: RouterState, stop: threading.Event) -> None:
+    """Proactive DLREQ01 checkpointing of in-flight greedy streams.
+
+    Every ``checkpoint_interval`` seconds, snapshot each tracked
+    stream's slot via ``GET /admin/checkpoint/<rid>`` on its current
+    backend and cache the record.  When that backend later dies
+    mid-stream, tier-1 resume imports the cached record on a peer —
+    the request restarts from the checkpoint's KV state instead of
+    re-prefilling the whole prompt (the win grows with context
+    length).  A failed poll is skipped, never fatal: the stream it
+    covers is still live and tier-2 re-run remains available."""
+    while not stop.wait(state.checkpoint_interval):
+        for rid, b in state.checkpoint_targets():
+            try:
+                # short deadline: one hung replica must not stall the
+                # whole poll round for upstream_timeout
+                conn = http.client.HTTPConnection(
+                    b.host, b.port,
+                    timeout=max(2.0, state.checkpoint_interval))
+                try:
+                    conn.request("GET", f"/admin/checkpoint/{rid}")
+                    resp = conn.getresponse()
+                    data = resp.read()
+                finally:
+                    conn.close()
+            except OSError:
+                continue
+            if resp.status == 200 and data:
+                state.checkpoints.put(rid, data)
+        state.checkpoints.sweep()
+
+
 def serve(state: RouterState, *, host: str = "0.0.0.0",
           port: int = 9990) -> None:
     httpd = ThreadingHTTPServer((host, port), make_handler(state))
     httpd.daemon_threads = True
     state.registry.start()
+    ckpt_stop = threading.Event()
+    ckpt_thread = None
+    if state.checkpoint_interval > 0:
+        ckpt_thread = threading.Thread(
+            target=_checkpoint_loop, args=(state, ckpt_stop),
+            name="router-checkpoint", daemon=True)
+        ckpt_thread.start()
 
     def _shutdown(signum, frame):
         _log.info("router signal %d: shutting down", signum)
@@ -723,6 +1064,9 @@ def serve(state: RouterState, *, host: str = "0.0.0.0",
     try:
         httpd.serve_forever()
     finally:
+        ckpt_stop.set()
+        if ckpt_thread is not None:
+            ckpt_thread.join(timeout=state.checkpoint_interval + 3.0)
         state.registry.stop()
         httpd.server_close()
 
@@ -742,6 +1086,9 @@ def main(args) -> None:
     state = RouterState(
         registry,
         retries=getattr(args, "router_retries", 2),
-        upstream_timeout=getattr(args, "upstream_timeout", 120.0))
+        upstream_timeout=getattr(args, "upstream_timeout", 120.0),
+        stall_timeout=getattr(args, "stall_timeout", 0.0),
+        checkpoint_interval=getattr(args, "checkpoint_interval", 0.0),
+        resume_policy=getattr(args, "resume_policy", "auto"))
     serve(state, host=getattr(args, "host", "0.0.0.0"),
           port=getattr(args, "port", 9990))

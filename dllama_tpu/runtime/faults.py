@@ -34,6 +34,12 @@ Fault points (the arming side never needs code changes to add more —
   (runtime/engine.py, ``--numeric-checks``); ``nan`` poisons the checked
   logits so the ``NumericFault`` path is testable without real
   corruption.
+* ``pod.respawn``           — in the serve-pod supervisor
+  (router/pod.py) before a dead/hung replica is respawned; a
+  ``raise``/``delay`` here is a respawn that fails or stalls, the
+  injectable stand-in for "the replacement process cannot start"
+  (exec failure, device still held by the corpse).  The supervisor
+  treats a raising respawn as another death in the crash-loop window.
 
 Spec grammar (``DLLAMA_FAULTS`` or :meth:`FaultRegistry.install`)::
 

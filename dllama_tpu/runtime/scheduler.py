@@ -591,6 +591,31 @@ class SlotScheduler:
             _log.info("handoff export", extra={"requests": len(records)})
         return records
 
+    def checkpoint_export(self, rid: str) -> bytes | None:
+        """Non-destructive DLREQ01 snapshot of ONE live slot, keyed by
+        request id — the proactive-checkpoint twin of
+        :meth:`handoff_export_all`.  The slot keeps decoding afterwards;
+        the record is a point-in-time copy the router caches so a
+        replica that later dies *ungracefully* can be resumed from the
+        checkpoint instead of paying a full re-prefill.
+
+        Runs inside :meth:`_flushed` so the snapshot only ever observes
+        step-boundary state (same invariant as the drain exporter).  A
+        resumed checkpoint is allowed to be stale: the importer's
+        ``emitted_chars`` cursor re-decodes the tokens between the
+        checkpoint and what the client already saw and emits nothing
+        until the cursor is passed, so greedy byte-parity holds for any
+        checkpoint age.  Returns ``None`` when the request is not in a
+        live slot (queued, parked, or already retired)."""
+        if self.pool is None:
+            return None
+        with self._flushed():
+            for i in self._active():
+                t = self.slots[i].ticket
+                if t is not None and t.rid == rid:
+                    return self._export_slot_locked(i)
+        return None
+
     def import_request(self, blob: bytes) -> tuple[Ticket, dict]:
         """Re-bind an exported request (DLREQ01 bytes) into a free slot:
         allocate this pool's own physical pages, write the exported page

@@ -37,6 +37,8 @@ import hashlib
 import json
 import os
 import struct
+import threading
+import time
 import zlib
 
 import numpy as np
@@ -272,3 +274,85 @@ def fingerprint(fields: dict) -> str:
     sha256 of the sorted-key JSON)."""
     blob = json.dumps(fields, sort_keys=True).encode("utf-8")
     return hashlib.sha256(blob).hexdigest()[:16]
+
+
+class RecordStore:
+    """TTL-bounded ``rid -> DLREQ01 bytes`` store.
+
+    Two users, same failure mode: a draining replica parks export
+    records for the router to claim (``--handoff-ttl``), and the router
+    caches periodic checkpoints of in-flight streams
+    (``--checkpoint-interval``).  In both cases an unclaimed record is a
+    leak — the replica's drain waits on it, the router's cache grows
+    without bound — so every read-side access sweeps expired entries
+    first and reports each expiry through ``on_expire`` (the replica
+    bumps ``dllama_handoff_expired_total`` there).
+
+    ``ttl <= 0`` disables expiry, which makes the store a plain dict
+    with a lock — the pre-TTL behavior, byte for byte.  The mapping
+    surface (``pop``/``put``/``update``/``__len__``/``__bool__``/
+    ``discard``) is intentionally the subset ``ApiState.handoff_records``
+    callers already use, so the store is a drop-in replacement.
+    """
+
+    def __init__(self, ttl: float = 0.0, on_expire=None):
+        self.ttl = float(ttl)
+        self.on_expire = on_expire
+        self._lock = threading.Lock()
+        self._items: dict[str, tuple[bytes, float]] = {}
+
+    def _sweep_locked(self) -> None:
+        if self.ttl <= 0 or not self._items:
+            return
+        now = time.monotonic()
+        dead = [rid for rid, (_, born) in self._items.items()
+                if now - born > self.ttl]
+        for rid in dead:
+            del self._items[rid]
+        if dead and self.on_expire is not None:
+            for rid in dead:
+                try:
+                    self.on_expire(rid)
+                except Exception:  # noqa: BLE001 — expiry is best-effort
+                    _log.warning("record_expire_callback_failed",
+                                 extra={"rid": rid})
+
+    def put(self, rid: str, blob: bytes) -> None:
+        with self._lock:
+            self._items[rid] = (blob, time.monotonic())
+
+    def update(self, records: dict) -> None:
+        now = time.monotonic()
+        with self._lock:
+            for rid, blob in records.items():
+                self._items[rid] = (blob, now)
+
+    def pop(self, rid: str, default=None):
+        with self._lock:
+            self._sweep_locked()
+            item = self._items.pop(rid, None)
+        return item[0] if item is not None else default
+
+    def get(self, rid: str, default=None):
+        with self._lock:
+            self._sweep_locked()
+            item = self._items.get(rid)
+        return item[0] if item is not None else default
+
+    def discard(self, rid: str) -> None:
+        with self._lock:
+            self._items.pop(rid, None)
+
+    def sweep(self) -> int:
+        """Explicit expiry pass; returns how many records remain."""
+        with self._lock:
+            self._sweep_locked()
+            return len(self._items)
+
+    def __len__(self) -> int:
+        with self._lock:
+            self._sweep_locked()
+            return len(self._items)
+
+    def __bool__(self) -> bool:
+        return len(self) > 0

@@ -80,7 +80,7 @@ from ..runtime.faults import FAULTS
 from ..runtime.scheduler import (PRIORITY_LEVELS, PRIORITY_NAMES,
                                  SchedulerClosed, SchedulerSaturated,
                                  SlotScheduler)
-from ..runtime.snapshot import SnapshotMismatch
+from ..runtime.snapshot import RecordStore, SnapshotMismatch
 from ..runtime.stream import drain_generation
 from .backoff import jittered_retry_after
 from ..tokenizer.bpe import Tokenizer
@@ -346,7 +346,8 @@ class ApiState:
                  io_timeout: float = 15.0, drain_grace: float = 30.0,
                  snapshot_dir: str | None = None,
                  scheduler: SlotScheduler | None = None,
-                 slo=None, handoff: bool = False):
+                 slo=None, handoff: bool = False,
+                 handoff_ttl: float = 0.0):
         self.engine = engine
         self.snapshot_dir = snapshot_dir
         self.batch_engine = batch_engine
@@ -380,7 +381,15 @@ class ApiState:
         # a peer, instead of finishing them here within the grace window
         self.handoff = bool(handoff and scheduler is not None
                             and scheduler.pool is not None)
-        self.handoff_records: dict[str, bytes] = {}
+        # unclaimed export records expire after --handoff-ttl: a router
+        # that died between the drain and the GET /admin/export/<rid>
+        # pickup must not park the record (and this drain) forever
+        self.handoff_records = RecordStore(
+            ttl=handoff_ttl, on_expire=self._handoff_expired)
+
+    def _handoff_expired(self, rid: str) -> None:
+        obs_metrics.HANDOFF_EXPIRED.inc()
+        _log.warning("handoff_record_expired", extra={"rid": rid})
 
     # -- admission / drain ---------------------------------------------
     def try_enter(self) -> str:
@@ -1665,6 +1674,32 @@ def make_handler(state: ApiState):
                     _log.info("handoff_export_served", extra={
                         "bytes": len(rec)})
                     self._bytes(200, rec, "application/octet-stream")
+            elif path.startswith("/admin/checkpoint/"):
+                # proactive mid-stream checkpoint (fleet router crash
+                # resume): a NON-destructive DLREQ01 snapshot of one
+                # live slot — the request keeps decoding here.  Unlike
+                # /admin/export this is repeatable; the router caches
+                # the newest record and resumes from it if this replica
+                # later dies ungracefully.
+                rid = path[len("/admin/checkpoint/"):]
+                if not state.handoff:
+                    self._json(404, {"error": "hand-off is not enabled "
+                                              "(--handoff)"})
+                    return
+                try:
+                    rec = state.scheduler.checkpoint_export(rid)
+                except Exception as e:  # noqa: BLE001 — a failed
+                    # checkpoint must never take down the live request
+                    _log.warning("checkpoint_export_failed", extra={
+                        "rid": rid, "error": repr(e)})
+                    rec = None
+                if rec is None:
+                    self._json(404, {"error": f"no live slot for "
+                                              f"request id {rid!r}"})
+                else:
+                    _log.debug("checkpoint_export_served", extra={
+                        "rid": rid, "bytes": len(rec)})
+                    self._bytes(200, rec, "application/octet-stream")
             elif path == "/debug/timeline":
                 # slot timeline + goodput decomposition (obs/flight.py +
                 # scheduler accounting); trace_dump.py --slots renders it
@@ -2609,7 +2644,8 @@ def main(argv=None):
                      drain_grace=args.drain_grace,
                      snapshot_dir=args.snapshot_dir,
                      scheduler=scheduler,
-                     slo=slo, handoff=getattr(args, "handoff", False))
+                     slo=slo, handoff=getattr(args, "handoff", False),
+                     handoff_ttl=getattr(args, "handoff_ttl", 0.0))
     if args.snapshot_dir:
         state.restore_snapshot()
     try:

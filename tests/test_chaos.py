@@ -1,0 +1,173 @@
+"""Pod-supervisor crash-tolerance tests (router/pod.py Supervisor).
+
+The unit tier drives a real :class:`Supervisor` over trivial child
+processes — no jax, no model load — and pins the three failure shapes
+from docs/ROBUSTNESS.md: death → respawn (same port, counted in
+``dllama_pod_respawns_total``), crash loop → quarantine (no respawn
+storm), hang (alive but /health silent) → SIGKILL + respawn with
+``reason="hung"``.  A raising ``pod.respawn`` fault point counts as
+another death, so a supervisor that cannot exec converges to quarantine
+instead of spinning.
+
+The slow tier runs tools/chaos_drill.py — the full supervised-pod soak
+under live SIGKILL/SIGSTOP chaos with byte-parity, availability, and
+KV-leak assertions.
+"""
+
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+from fixtures import REPO, free_port
+from dllama_tpu.obs import metrics as obs_metrics
+from dllama_tpu.router.pod import Supervisor, _Replica
+from dllama_tpu.runtime.faults import injected
+
+pytestmark = pytest.mark.chaos
+
+_SLEEPER = [sys.executable, "-c", "import time; time.sleep(600)"]
+
+
+def _wait(cond, timeout=30.0, msg="condition never held"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(msg)
+
+
+def _mk_supervisor(argv, *, idx=0, port=None, **kw):
+    rep = _Replica(idx, port if port is not None else free_port(),
+                   list(argv), dict(os.environ))
+    defaults = dict(respawn_max=5, respawn_window=30.0, hang_probes=2,
+                    poll_interval=0.05, probe_timeout=0.5)
+    defaults.update(kw)
+    return rep, Supervisor([rep], **defaults)
+
+
+def test_supervisor_respawns_killed_child():
+    """SIGKILL a supervised child → a replacement process appears on the
+    same port recipe, counted as one reason="exit" respawn."""
+    rep, sup = _mk_supervisor(_SLEEPER)
+    before = obs_metrics.POD_RESPAWNS.get(str(rep.idx), "exit")
+    sup.start()
+    try:
+        assert rep.proc is not None and rep.proc.poll() is None
+        pid0 = rep.proc.pid
+        rep.proc.kill()
+        _wait(lambda: rep.proc is not None and rep.proc.poll() is None
+              and rep.proc.pid != pid0,
+              msg="child was never respawned")
+        assert not rep.quarantined
+        assert sup.replicas_up() == 1
+        assert obs_metrics.POD_RESPAWNS.get(str(rep.idx), "exit") \
+            >= before + 1
+    finally:
+        sup.stop()
+
+
+def test_supervisor_quarantines_crash_loop():
+    """A child that exits immediately burns through respawn_max deaths
+    inside the window and is quarantined — never respawned forever."""
+    rep, sup = _mk_supervisor([sys.executable, "-c", "pass"],
+                              respawn_max=2, respawn_window=30.0)
+    sup.start()
+    try:
+        _wait(lambda: rep.quarantined, msg="crash loop never quarantined")
+        assert rep.proc is None
+        assert len(rep.deaths) > 2
+        assert sup.replicas_up() == 0
+        # quarantine is terminal for the watch loop: deaths stop growing
+        n = len(rep.deaths)
+        time.sleep(0.3)
+        assert len(rep.deaths) == n
+    finally:
+        sup.stop()
+
+
+def test_supervisor_respawn_fault_counts_as_death():
+    """An injected pod.respawn failure (exec refused, fork bomb guard…)
+    leaves no process; every poll without one counts as another death,
+    so the crash-loop window still converges to quarantine."""
+    rep, sup = _mk_supervisor(_SLEEPER, respawn_max=3)
+    exits_before = obs_metrics.POD_RESPAWNS.get(str(rep.idx), "exit")
+    with injected("pod.respawn=raise:RuntimeError"):
+        sup.start()
+        try:
+            _wait(lambda: rep.proc is not None and rep.proc.poll() is None,
+                  msg="child never spawned")
+            rep.proc.kill()
+            _wait(lambda: rep.quarantined,
+                  msg="failed respawns never converged to quarantine")
+        finally:
+            sup.stop()
+    # the respawn never succeeded, so the counter must not have moved
+    assert obs_metrics.POD_RESPAWNS.get(str(rep.idx), "exit") \
+        == exits_before
+
+
+def test_supervisor_detects_hang():
+    """SIGSTOP a child that was answering /health: the process is alive
+    but probes stall, so after hang_probes misses the supervisor
+    SIGKILLs and respawns it as reason="hung".  Hang detection arms only
+    after the first healthy probe — a child still loading is never
+    shot."""
+    port = free_port()
+    script = (
+        "import http.server\n"
+        "class H(http.server.BaseHTTPRequestHandler):\n"
+        "    def do_GET(self):\n"
+        "        self.send_response(200)\n"
+        "        self.send_header('Content-Length', '2')\n"
+        "        self.end_headers()\n"
+        "        self.wfile.write(b'ok')\n"
+        "    def log_message(self, *a): pass\n"
+        f"http.server.HTTPServer(('127.0.0.1', {port}), H).serve_forever()\n")
+    rep, sup = _mk_supervisor([sys.executable, "-c", script], port=port,
+                              poll_interval=0.1, hang_probes=2)
+    hung_before = obs_metrics.POD_RESPAWNS.get(str(rep.idx), "hung")
+    sup.start()
+    try:
+        _wait(lambda: rep.ready, msg="child never answered /health")
+        pid0 = rep.proc.pid
+        os.kill(pid0, signal.SIGSTOP)  # wedged: alive, silent
+        _wait(lambda: obs_metrics.POD_RESPAWNS.get(str(rep.idx), "hung")
+              >= hung_before + 1,
+              msg="hang was never detected")
+        _wait(lambda: rep.proc is not None and rep.proc.poll() is None
+              and rep.proc.pid != pid0,
+              msg="hung child was never replaced")
+        # the replacement serves the same port and goes ready again
+        _wait(lambda: rep.ready, msg="replacement never answered /health")
+    finally:
+        sup.stop()
+
+
+# -- the full chaos soak (tools/chaos_drill.py) ----------------------------
+
+def _run_chaos(quick: bool) -> None:
+    tools = os.path.join(REPO, "tools")
+    sys.path.insert(0, tools)
+    try:
+        from chaos_drill import run_drill
+    finally:
+        sys.path.remove(tools)
+    assert run_drill(quick=quick) == 0
+
+
+@pytest.mark.slow
+def test_chaos_drill_quick():
+    """tools/chaos_drill.py --quick: one SIGKILL into a supervised pod
+    under live traffic — parity, availability, respawn, no KV leak."""
+    _run_chaos(quick=True)
+
+
+@pytest.mark.slow
+def test_chaos_soak_full():
+    """The full soak: 4 alternating SIGKILL/SIGSTOP murders under a
+    trace-replay mix plus greedy parity streams."""
+    _run_chaos(quick=False)

@@ -25,6 +25,7 @@ proof of the fleet path (probes, least-loaded pick, relay, metrics).
 import http.server
 import json
 import threading
+import time
 
 import pytest
 
@@ -269,3 +270,244 @@ def test_serve_pod_smoke(tmp_path):
     # the end-of-run ledger names the off-TPU collective degrade — a pod
     # bench number can never read as the fused-collective number
     assert "tp_psum" in out, out[-2000:]
+
+
+# -- crash tolerance: RTT degradation, stall watchdog, resume --------------
+
+def test_rtt_degradation_buries_score():
+    """A 10× probe-RTT excursion past a backend's own floor carries the
+    same penalty as degraded/SLO-violating — capacity cannot buy it the
+    pick — with the documented clamps (1 ms floor, 50 ms threshold)."""
+    reg = Registry(["127.0.0.1:1", "127.0.0.1:2"])
+    fast, slow = reg.backends
+    fast.last_health = _health(free_slots=1)
+    slow.last_health = _health(free_slots=8)
+    assert not slow.rtt_degraded()        # no baseline yet: no signal
+    slow.rtt_floor = 0.002
+    slow.last_probe_s = 0.004             # 2× the floor: normal jitter
+    assert not slow.rtt_degraded()
+    slow.last_probe_s = 0.3               # 10× past floor AND > 50 ms
+    assert slow.rtt_degraded()
+    assert slow.summary()["rtt_degraded"] is True
+    assert Registry._score(fast) > Registry._score(slow)
+    assert reg.pick() is fast             # 8 free slots lose to 1
+    # sub-ms loopback floors are clamped: 10× of nothing is not a signal
+    slow.rtt_floor = 0.0001
+    slow.last_probe_s = 0.004
+    assert not slow.rtt_degraded()
+    # WAN-ish floors need a real excursion, not just the 10× ratio
+    slow.rtt_floor = 0.004
+    slow.last_probe_s = 0.045             # >10× but under the 50 ms gate
+    assert not slow.rtt_degraded()
+
+
+def test_force_eject_bypasses_hysteresis_readmit_does_not():
+    """force_eject (the stall watchdog's teeth) skips the failure-streak
+    wait, but the way back in stays hysteretic: readmit_after healthy
+    probes, not one."""
+    replica = _FakeReplica()
+    try:
+        reg = Registry([f"127.0.0.1:{replica.port}"],
+                       eject_after=3, readmit_after=2, probe_timeout=2.0)
+        b = reg.backends[0]
+        assert reg.probe(b) and reg.pick() is b
+        reg.force_eject(b, "stream stall (test)")
+        assert b.ejected and reg.pick() is None
+        assert reg.probe(b) and b.ejected      # 1 good probe: still out
+        assert reg.probe(b) and not b.ejected  # 2nd re-admits
+        assert reg.pick() is b
+    finally:
+        replica.close()
+
+
+def test_record_store_ttl():
+    """RecordStore: sweep-on-access expiry with the on_expire hook;
+    ttl<=0 keeps records forever (the plain-dict behavior)."""
+    from dllama_tpu.runtime.snapshot import RecordStore
+
+    expired: list[str] = []
+    rs = RecordStore(ttl=0.15, on_expire=expired.append)
+    rs.put("a", b"1")
+    rs.put("b", b"2")
+    assert rs.get("a") == b"1" and len(rs) == 2 and rs
+    time.sleep(0.25)
+    rs.put("c", b"3")                     # fresh record, post-expiry
+    assert rs.get("a") is None and rs.get("b") is None
+    assert sorted(expired) == ["a", "b"]
+    assert rs.pop("c") == b"3" and rs.pop("c", b"gone") == b"gone"
+    assert not rs
+    keep = RecordStore(ttl=0.0)
+    keep.put("x", b"y")
+    assert keep.get("x") == b"y" and len(keep) == 1
+    keep.discard("x")                     # discard never fires on_expire
+    assert not keep and keep.sweep() == 0
+
+
+class _StallingReplica:
+    """A replica that answers /health, streams ONE SSE chunk of a
+    completion, then goes silent while the socket stays open — the
+    wedged-but-connected shape only --stall-timeout can catch."""
+
+    def __init__(self, hold_s: float = 8.0):
+        outer = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                body = json.dumps(
+                    {"status": "serving",
+                     "capacity": {"free_slots": 2, "queue_depth": 0,
+                                  "free_kv_pages": 50,
+                                  "handoff": True}}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):  # noqa: N802
+                self.rfile.read(
+                    int(self.headers.get("Content-Length") or 0))
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.end_headers()
+                evt = {"id": "cmpl-stall", "model": "tiny", "created": 0,
+                       "choices": [{"index": 0, "text": "Hello",
+                                    "finish_reason": None}]}
+                self.wfile.write(b"data: " + json.dumps(evt).encode()
+                                 + b"\n\n")
+                self.wfile.flush()
+                outer.stalled.set()
+                time.sleep(hold_s)  # wedged: connected, silent
+
+            def log_message(self, *a):
+                pass
+
+        self.stalled = threading.Event()
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_stall_watchdog_cuts_wedged_stream():
+    """One backend wedges mid-stream (bytes sent, then silence): the
+    watchdog trips within --stall-timeout, force-ejects the backend,
+    the greedy resume ladder finds no peer, and the client gets the
+    honest replica_lost finish — never an indefinite hang."""
+    import urllib.request
+
+    from dllama_tpu.obs import metrics as obs_metrics
+    from dllama_tpu.router.service import RouterState, make_handler
+
+    replica = _StallingReplica()
+    state = None
+    server = None
+    try:
+        reg = Registry([f"127.0.0.1:{replica.port}"], probe_timeout=2.0)
+        assert reg.probe(reg.backends[0])
+        # resume_window=0: with the only backend wedged there is no peer
+        # to resume on — don't spend the grace window finding that out
+        state = RouterState(reg, retries=1, upstream_timeout=30.0,
+                            stall_timeout=1.0, resume_window=0.0)
+        server = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), make_handler(state))
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        stalls0 = obs_metrics.ROUTER_STALLS.value
+        nopeer0 = obs_metrics.ROUTER_RESUMES.get("no_peer")
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.server_address[1]}/v1/completions",
+            json.dumps({"prompt": "hi", "max_tokens": 8, "stream": True,
+                        "temperature": 0}).encode(),
+            headers={"Content-Type": "application/json"})
+        text, finish = "", None
+        t0 = time.monotonic()
+        with urllib.request.urlopen(req, timeout=30) as r:
+            for line in r:
+                line = line.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                payload = line[len(b"data: "):]
+                if payload == b"[DONE]":
+                    break
+                c = json.loads(payload)["choices"][0]
+                text += c.get("text") or ""
+                if c.get("finish_reason"):
+                    finish = c["finish_reason"]
+        elapsed = time.monotonic() - t0
+        assert text == "Hello"
+        assert finish == "replica_lost"
+        assert elapsed < 6.0, f"watchdog too slow: {elapsed:.1f}s"
+        assert obs_metrics.ROUTER_STALLS.value >= stalls0 + 1
+        # greedy + auto: the resume ladder ran and honestly reported
+        # the empty fleet rather than silently truncating
+        assert obs_metrics.ROUTER_RESUMES.get("no_peer") >= nopeer0 + 1
+        assert reg.backends[0].ejected  # forced out, not streak-waited
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        replica.close()
+
+
+def test_resume_policy_validation():
+    """resume_policy is a router-level contract: bogus values 400 before
+    any backend is touched; valid values are accepted (and the field is
+    never forwarded upstream — asserted by the drills' byte parity)."""
+    import urllib.error
+    import urllib.request
+
+    from dllama_tpu.router.service import RouterState, make_handler
+
+    reg = Registry(["127.0.0.1:1"])  # never probed: no traffic possible
+    state = RouterState(reg)
+    server = http.server.ThreadingHTTPServer(
+        ("127.0.0.1", 0), make_handler(state))
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        def post(body):
+            req = urllib.request.Request(
+                base + "/v1/completions", json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            return urllib.request.urlopen(req, timeout=10)
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"prompt": "x", "resume_policy": "sometimes"})
+        assert ei.value.code == 400
+        assert b"resume_policy" in ei.value.read()
+        # a valid policy passes validation and reaches dispatch, which
+        # honestly 503s on the never-probed fleet
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"prompt": "x", "resume_policy": "never"})
+        assert ei.value.code == 503
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_crash_resume_drill(tmp_path):
+    """tools/fault_drill.py crash_resume wired as a test: SIGKILL a
+    replica mid-greedy-stream behind a resume-enabled router → the
+    client's text is byte-identical to the solo oracle with finish
+    stop/length; a sampled (non-greedy) stream killed the same way
+    keeps the honest replica_lost."""
+    import os
+    import sys
+
+    tools = os.path.join(REPO, "tools")
+    sys.path.insert(0, tools)
+    try:
+        from fault_drill import drill_crash_resume
+    finally:
+        sys.path.remove(tools)
+    model = str(tmp_path / "tiny.m")
+    tok = str(tmp_path / "tiny.t")
+    write_tiny_model(model)
+    write_tiny_tokenizer(tok)
+    drill_crash_resume(model, tok)

@@ -1,0 +1,457 @@
+#!/usr/bin/env python
+"""Chaos soak: a supervised serve-pod fleet under live process murder.
+
+Boots ``dllama serve-pod --supervise`` (replica child processes under
+the pod supervisor, fleet router on one public port) on the tests' tiny
+CPU model, drives a trace-replay workload plus dedicated greedy parity
+streams at it, and meanwhile SIGKILLs / SIGSTOPs replica children on a
+schedule.  The soak PASSES only if the whole crash-tolerance story held
+(docs/ROBUSTNESS.md):
+
+* **zero wrong bytes** — every greedy parity stream's text is
+  byte-identical to the pre-chaos solo oracle, finish stop/length
+  (transparent mid-stream resume, never silent truncation);
+* **honest finish reasons** — the replay mix (sampled, not resumable)
+  sees only stop/length/replica_lost/preempted, zero transport errors;
+* **bounded unavailability** — the router's fleet aggregate never goes
+  dark longer than the recovery bound (p95 and max window asserted);
+* **zero leaked KV pages** — every replica's paged pool drains back to
+  its full size once the workload quiesces;
+* **capacity restored** — the supervisor respawned every victim
+  (``dllama_pod_respawns_total`` grew) and the registry re-admitted
+  them: fleet ``available`` is back to ``--dp``.
+
+Usage::
+
+    python tools/chaos_drill.py             # full soak (several minutes)
+    python tools/chaos_drill.py --quick     # single-kill smoke (~2 min)
+
+Exit code 0 iff every assertion held.  CPU-only, stdlib-only, no
+accelerator needed — the point is the process/protocol machinery, not
+the math.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))   # tiny-model fixtures
+sys.path.insert(0, os.path.join(REPO, "tools"))   # trace_replay library
+
+GREEDY_BODY = {"prompt": "Once upon a time", "max_tokens": 32,
+               "temperature": 0, "stream": True,
+               # interactive: the parity probes measure crash tolerance,
+               # not overload policy — the replay mix saturates the fleet
+               # and a shed (429) retry after a crash would end an
+               # admitted stream with an honest replica_lost
+               "priority": "interactive"}
+
+
+def get(base: str, path: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def stream_once(base: str, body: dict, out: dict | None = None,
+                timeout: float = 240.0) -> tuple[str, str | None]:
+    """One streamed completion; returns (text, finish_reason).  ``out``
+    (optional) is live-updated with ``chars`` so a chaos thread can wait
+    for the stream to be mid-flight before killing its replica."""
+    req = urllib.request.Request(
+        base + "/v1/completions", json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    text, finish = "", None
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        for line in r:
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            payload = line[len(b"data: "):]
+            if payload == b"[DONE]":
+                break
+            evt = json.loads(payload)
+            c = evt["choices"][0]
+            text += c.get("text") or ""
+            if out is not None:
+                out["chars"] = len(text)
+            if c.get("finish_reason"):
+                finish = c["finish_reason"]
+    return text, finish
+
+
+# -- /proc spelunking (Linux): find the pod's replica children ----------
+
+def children_of(pid: int) -> list[int]:
+    kids = []
+    for d in os.listdir("/proc"):
+        if not d.isdigit():
+            continue
+        try:
+            with open(f"/proc/{d}/stat") as f:
+                data = f.read()
+            ppid = int(data.rpartition(")")[2].split()[1])
+        except (OSError, ValueError, IndexError):
+            continue
+        if ppid == pid:
+            kids.append(int(d))
+    return kids
+
+
+def child_by_port(pod_pid: int, port: int) -> int | None:
+    """The replica child serving ``--port <port>`` (from its cmdline)."""
+    want = str(port).encode()
+    for kid in children_of(pod_pid):
+        try:
+            with open(f"/proc/{kid}/cmdline", "rb") as f:
+                args = f.read().split(b"\0")
+        except OSError:
+            continue
+        for i, a in enumerate(args[:-1]):
+            if a == b"--port" and args[i + 1] == want:
+                return kid
+    return None
+
+
+class Pod:
+    """One ``serve-pod --supervise`` process (router + supervisor +
+    replica children) on the tiny fixture model."""
+
+    def __init__(self, model: str, tok: str, *, dp: int = 2,
+                 snapshot_dir: str | None = None, faults: str = ""):
+        from fixtures import cpu_env, free_port
+        self.dp = dp
+        self.port = free_port()
+        self.base = f"http://127.0.0.1:{self.port}"
+        env = cpu_env()
+        if faults:
+            # inherited by the replica children — the supervisor parent
+            # never hits engine fault points itself
+            env["DLLAMA_FAULTS"] = faults
+        argv = [sys.executable, "-m", "dllama_tpu", "serve-pod",
+                "--supervise", "--dp", str(dp),
+                "--model", model, "--tokenizer", tok,
+                "--port", str(self.port),
+                "--temperature", "0", "--max-seq-len", "64",
+                "--batch-slots", "2", "--kv-pages", "64",
+                "--kv-page-size", "4", "--no-prefix-reuse",
+                "--handoff",
+                "--probe-interval", "0.5", "--eject-after", "2",
+                "--readmit-after", "2", "--router-retries", "3",
+                "--checkpoint-interval", "1",
+                "--stall-timeout", "10",
+                # generous crash-loop budget: the drill's own murders
+                # must not quarantine anyone
+                "--respawn-max", "20", "--respawn-window", "60"]
+        if snapshot_dir:
+            argv += ["--snapshot-dir", snapshot_dir]
+        self.proc = subprocess.Popen(argv, cwd=REPO, env=env,
+                                     stdout=subprocess.PIPE,
+                                     stderr=subprocess.STDOUT, text=True)
+
+    def wait_ready(self, timeout: float = 300.0) -> None:
+        """Up = every replica admitted (children each load the model)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"pod died:\n{self.proc.stdout.read()[-4000:]}")
+            try:
+                if get(self.base, "/health", 2)["available"] >= self.dp:
+                    return
+            except OSError:
+                pass
+            time.sleep(0.5)
+        raise RuntimeError("pod fleet never became fully available")
+
+    def backend_ports(self) -> list[int]:
+        rows = get(self.base, "/health")["backends"]
+        return [int(r["addr"].rpartition(":")[2]) for r in rows]
+
+    def kill_replica(self, port: int, sig: int) -> bool:
+        kid = child_by_port(self.proc.pid, port)
+        if kid is None:
+            return False
+        os.kill(kid, sig)
+        return True
+
+    def active_port(self) -> int | None:
+        """Port of a replica currently decoding a scheduler request."""
+        for p in self.backend_ports():
+            try:
+                h = get(f"http://127.0.0.1:{p}", "/health", 2)
+            except OSError:
+                continue
+            if (h.get("scheduler") or {}).get("active", 0) >= 1:
+                return p
+        return None
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        self.proc.wait()
+
+
+class AvailabilitySampler:
+    """Samples the router's fleet aggregate; reports unavailability
+    windows (consecutive samples with no dispatchable backend)."""
+
+    def __init__(self, base: str, period: float = 0.25):
+        self.base = base
+        self.period = period
+        self.samples: list[tuple[float, bool]] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period):
+            try:
+                ok = get(self.base, "/health", 2)["available"] >= 1
+            except OSError:
+                ok = False
+            self.samples.append((time.monotonic(), ok))
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def windows(self) -> list[float]:
+        """Durations (s) of each contiguous unavailable run."""
+        out, start = [], None
+        for t, ok in self.samples:
+            if not ok and start is None:
+                start = t
+            elif ok and start is not None:
+                out.append(t - start)
+                start = None
+        if start is not None and self.samples:
+            out.append(self.samples[-1][0] - start)
+        return out
+
+
+def _pct(vals: list[float], q: float) -> float:
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    return s[min(len(s) - 1, max(0, round(q * (len(s) - 1))))]
+
+
+def run_drill(*, quick: bool) -> int:
+    from fixtures import write_tiny_model, write_tiny_tokenizer
+    from trace_replay import replay_trace, synth_trace
+
+    kills = 1 if quick else 4
+    n_req = 16 if quick else 64
+    rate = 4.0 if quick else 6.0
+    n_parity = 2 if quick else 6
+    failures: list[str] = []
+
+    def check(cond: bool, msg: str) -> None:
+        mark = "✅" if cond else "❌"
+        print(f"{mark} {msg}")
+        if not cond:
+            failures.append(msg)
+
+    with tempfile.TemporaryDirectory() as d:
+        model, tok = os.path.join(d, "tiny.m"), os.path.join(d, "tiny.t")
+        write_tiny_model(model)
+        write_tiny_tokenizer(tok)
+        pod = Pod(model, tok, dp=2,
+                  snapshot_dir=os.path.join(d, "snap"),
+                  # stretch decode so kills land mid-stream
+                  faults="engine.device_step=delay:0.05")
+        try:
+            t0 = time.monotonic()
+            pod.wait_ready()
+            print(f"fleet up in {time.monotonic() - t0:.0f}s "
+                  f"(router {pod.base}, replicas {pod.backend_ports()})")
+
+            # solo greedy oracle, zero chaos: the byte-parity reference
+            oracle, fin = stream_once(pod.base, GREEDY_BODY)
+            assert fin in ("stop", "length") and oracle, (fin, oracle)
+
+            sampler = AvailabilitySampler(pod.base)
+            sampler.start()
+
+            replay_out: dict = {}
+
+            def replay():
+                replay_out["report"] = replay_trace(
+                    pod.base, synth_trace(n_req, rate, max_tokens=12),
+                    mix="interactive=0.3,standard=0.4,batch=0.3",
+                    timeout=240.0)
+
+            parity: list[tuple[str, str | None] | Exception] = []
+            chaos_done = threading.Event()
+
+            def parity_loop():
+                # keep greedy traffic flowing until the last murder has
+                # landed (the kill loop targets whichever replica is
+                # decoding — without live streams it would starve), then
+                # top up to at least n_parity streams
+                while not (chaos_done.is_set()
+                           and len(parity) >= n_parity):
+                    if len(parity) >= n_parity * 8:  # runaway guard
+                        break
+                    try:
+                        parity.append(stream_once(
+                            pod.base, GREEDY_BODY, live))
+                    except Exception as e:  # noqa: BLE001 — asserted below
+                        parity.append(e)
+
+            live: dict = {}
+            rt = threading.Thread(target=replay, daemon=True)
+            pt = threading.Thread(target=parity_loop, daemon=True)
+            rt.start()
+            pt.start()
+
+            # chaos: murder the replica that is actually decoding,
+            # alternating outright death (SIGKILL) and a wedge (SIGSTOP
+            # — the supervisor's hang detector must SIGKILL + respawn)
+            killed = 0
+            deadline = time.monotonic() + (120 if quick else 300)
+            while killed < kills and time.monotonic() < deadline:
+                # murder only at full strength: the resume contract needs
+                # a healthy peer, so back-to-back murders must not overlap
+                # a victim still respawning/re-admitting
+                try:
+                    if get(pod.base, "/health", 2)["available"] < pod.dp:
+                        time.sleep(0.5)
+                        continue
+                except OSError:
+                    time.sleep(0.5)
+                    continue
+                port = pod.active_port()
+                if port is None:
+                    time.sleep(0.2)
+                    continue
+                sig = signal.SIGKILL if killed % 2 == 0 \
+                    else signal.SIGSTOP
+                if pod.kill_replica(port, sig):
+                    killed += 1
+                    print(f"💀 sent {signal.Signals(sig).name} to "
+                          f"replica :{port} ({killed}/{kills})")
+                    time.sleep(3.0 if quick else 8.0)  # let it recover
+            chaos_done.set()
+            rt.join(300)
+            pt.join(300)
+            sampler.stop()
+
+            check(killed == kills,
+                  f"chaos injected: {killed}/{kills} replica murders")
+
+            # zero wrong bytes on greedy streams
+            bad = [p for p in parity
+                   if isinstance(p, Exception)
+                   or p[1] not in ("stop", "length") or p[0] != oracle]
+            check(not bad,
+                  f"greedy byte parity: {len(parity) - len(bad)}/"
+                  f"{len(parity)} streams identical to oracle"
+                  + (f" (bad: {bad[:2]})" if bad else ""))
+
+            # honest finish reasons + zero transport errors on the mix
+            rep = replay_out.get("report") or {}
+            classes = rep.get("classes") or {}
+            errs = sum(c["errors"] for c in classes.values())
+            finishes = set()
+            for c in classes.values():
+                finishes |= set(c["finish_reasons"])
+            check(classes != {} and errs == 0,
+                  f"replay mix: 0 transport errors "
+                  f"({sum(c['sent'] for c in classes.values())} sent, "
+                  f"{sum(c['shed_429'] for c in classes.values())} shed)")
+            # "preempted" is honest too: the QoS layer parks batch work
+            # under interactive pressure and finishes it truthfully when
+            # the parked area overflows (docs/SERVING.md QoS)
+            check(finishes <= {"stop", "length", "replica_lost",
+                              "preempted"},
+                  f"honest finish reasons only: {sorted(finishes)}")
+
+            # bounded unavailability
+            wins = sampler.windows()
+            p95 = _pct(wins, 0.95)
+            check(p95 <= 15.0 and max(wins, default=0.0) <= 45.0,
+                  f"unavailability bounded: p95={p95:.1f}s "
+                  f"max={max(wins, default=0.0):.1f}s "
+                  f"({len(wins)} windows)")
+
+            # capacity restored: every victim respawned + re-admitted
+            deadline = time.monotonic() + 180
+            avail = 0
+            while time.monotonic() < deadline:
+                avail = get(pod.base, "/health")["available"]
+                if avail >= pod.dp:
+                    break
+                time.sleep(1.0)
+            check(avail >= pod.dp,
+                  f"fleet capacity restored: {avail}/{pod.dp} available")
+            m = get(pod.base, "/metrics")
+            respawns = sum((m.get("pod_respawns") or {}).values())
+            check(respawns >= killed,
+                  f"supervisor respawned every victim "
+                  f"(pod_respawns={respawns})")
+            print(f"   resumes={m.get('router_resumes')} "
+                  f"stalls={m.get('router_stalls', 0)} "
+                  f"replica_lost={m.get('router_replica_lost', 0)} "
+                  f"retries={m.get('router_retries', 0)}")
+
+            # zero leaked KV pages once quiesced
+            leaks = []
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                leaks = []
+                for p in pod.backend_ports():
+                    try:
+                        occ = get(f"http://127.0.0.1:{p}",
+                                  "/health", 2).get("scheduler") or {}
+                    except OSError:
+                        leaks.append((p, "unreachable"))
+                        continue
+                    if occ.get("active") or occ.get("queued") \
+                            or occ.get("parked") \
+                            or occ.get("kv_pages_free") \
+                            != occ.get("kv_pages_total"):
+                        leaks.append((p, occ))
+                if not leaks:
+                    break
+                time.sleep(1.0)
+            check(not leaks,
+                  "zero leaked KV pages"
+                  + (f" (leaks: {leaks[:2]})" if leaks else ""))
+        finally:
+            pod.stop()
+
+    if failures:
+        print(f"\n{len(failures)} chaos assertion(s) FAILED")
+        return 1
+    print("\nchaos drill passed")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--quick", action="store_true",
+                    help="single-kill smoke instead of the full soak")
+    args = ap.parse_args(argv)
+    return run_drill(quick=args.quick)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

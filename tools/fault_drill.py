@@ -854,6 +854,165 @@ def drill_replica_failover(model, tok):
         b.stop()
 
 
+def drill_crash_resume(model, tok):
+    """SIGKILL a replica mid-greedy-stream behind a resume-enabled
+    router: the client's stream keeps going on the survivor and the
+    total text is byte-identical to an uncontended solo run — finish
+    reason stop/length, never replica_lost.  Afterwards the survivor
+    shows zero leaked KV pages and the restarted victim re-admits
+    (the same respawn-at-same-port recovery ``serve-pod --supervise``
+    automates)."""
+    flags = ["--batch-slots", "2", "--kv-pages", "64", "--kv-page-size",
+             "4", "--io-timeout", "30", "--handoff", "--no-prefix-reuse"]
+    body = {"prompt": "Once upon a time", "max_tokens": 40,
+            "temperature": 0, "stream": True}
+    a = Server(model, tok, faults="engine.device_step=delay:0.15",
+               extra_flags=flags)
+    b = Server(model, tok, faults="engine.device_step=delay:0.15",
+               extra_flags=flags)
+    router = None
+    restarted = None
+    try:
+        a.wait_ready()
+        b.wait_ready()
+        router = Router([a.port, b.port], probe_interval=0.5,
+                        eject_after=2, readmit_after=2, router_retries=3,
+                        checkpoint_interval=1)
+        router.wait_ready()
+        time.sleep(1.2)  # one probe round so both backends are scored
+
+        def run_stream(out: dict, req_body: dict = body):
+            req = urllib.request.Request(
+                router.base + "/v1/completions",
+                json.dumps(req_body).encode(),
+                headers={"Content-Type": "application/json"})
+            text, finish = "", None
+            with urllib.request.urlopen(req, timeout=240) as r:
+                for line in r:
+                    line = line.strip()
+                    if not line.startswith(b"data: "):
+                        continue
+                    payload = line[len(b"data: "):]
+                    if payload == b"[DONE]":
+                        break
+                    evt = json.loads(payload)
+                    c = evt["choices"][0]
+                    text += c.get("text") or ""
+                    out["chars"] = len(text)
+                    if c.get("finish_reason"):
+                        finish = c["finish_reason"]
+            out.update(text=text, finish=finish)
+
+        # solo greedy oracle, no kill: the byte-parity reference
+        oracle: dict = {}
+        run_stream(oracle)
+        assert oracle["finish"] in ("stop", "length"), oracle
+
+        victim_run: dict = {}
+        st = threading.Thread(target=run_stream, args=(victim_run,))
+        st.start()
+        # wait for content at the CLIENT, then find the decoding replica
+        victim = survivor = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if victim_run.get("chars", 0) < 1:
+                time.sleep(0.05)
+                continue
+            for srv, other in ((a, b), (b, a)):
+                try:
+                    h = get(srv.base, "/health")
+                except OSError:
+                    continue
+                if (h.get("scheduler") or {}).get("active", 0) >= 1:
+                    victim, survivor = srv, other
+                    break
+            if victim is not None:
+                break
+            time.sleep(0.05)
+        assert victim is not None, "stream never became active"
+        victim.proc.kill()  # SIGKILL: no drain, no hand-off — a crash
+        st.join(240)
+        # the resume contract: the client never saw the crash
+        assert victim_run.get("finish") in ("stop", "length"), victim_run
+        assert victim_run["text"] == oracle["text"], \
+            f"resume drift:\n {victim_run['text']!r}\n != {oracle['text']!r}"
+        # the outcome counter lands just AFTER the client's [DONE] (the
+        # handler closes the peer connection first) — poll briefly
+        resumes: dict = {}
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            resumes = get(router.base, "/metrics") \
+                .get("router_resumes") or {}
+            if sum(resumes.values()) >= 1:
+                break
+            time.sleep(0.2)
+        assert sum(resumes.values()) >= 1, resumes
+        assert set(resumes) <= {"checkpoint", "rerun"}, resumes
+        # zero leaked KV pages on the survivor
+        occ = get(survivor.base, "/health")["scheduler"]
+        assert occ["active"] == 0 and occ["queued"] == 0, occ
+        assert occ["kv_pages_free"] == occ["kv_pages_total"], \
+            f"page leak: {occ}"
+        # respawn at the same port → hysteretic re-admission
+        restarted = Server(model, tok,
+                           faults="engine.device_step=delay:0.15",
+                           extra_flags=flags, port=victim.port)
+        restarted.wait_ready()
+        vkey = f"127.0.0.1:{victim.port}"
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            rows = {r["addr"]: r for r in
+                    get(router.base, "/health")["backends"]}
+            if not rows[vkey]["ejected"]:
+                break
+            time.sleep(0.25)
+        else:
+            raise AssertionError("restarted replica never re-admitted")
+
+        # non-greedy: no byte-parity guarantee exists, so even on this
+        # resume-enabled router a mid-stream crash keeps the honest
+        # finish_reason="replica_lost" — never a silently resampled tail
+        sampled_run: dict = {}
+        # no seed: seeded sampling rides the mutex path, which --kv-pages
+        # replicas refuse — plain temperature>0 stays on the scheduler
+        st = threading.Thread(target=run_stream, args=(
+            sampled_run, dict(body, temperature=0.8)))
+        st.start()
+        victim2 = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if sampled_run.get("chars", 0) < 1:
+                time.sleep(0.05)
+                continue
+            for srv in (survivor, restarted):
+                try:
+                    h = get(srv.base, "/health")
+                except OSError:
+                    continue
+                if (h.get("scheduler") or {}).get("active", 0) >= 1:
+                    victim2 = srv
+                    break
+            if victim2 is not None:
+                break
+            time.sleep(0.05)
+        assert victim2 is not None, "sampled stream never became active"
+        victim2.proc.kill()
+        st.join(240)
+        assert sampled_run.get("finish") == "replica_lost", sampled_run
+        m = get(router.base, "/metrics")
+        assert m.get("router_replica_lost", 0) >= 1, m
+        # the sampled loss must not have minted any resume outcome
+        assert set(m.get("router_resumes") or {}) <= \
+            {"checkpoint", "rerun"}, m
+    finally:
+        if router is not None:
+            router.stop()
+        if restarted is not None:
+            restarted.stop()
+        a.stop()
+        b.stop()
+
+
 DRILLS = {
     "deadline": drill_deadline,
     "disconnect": drill_disconnect,
@@ -870,6 +1029,7 @@ DRILLS = {
     "overlap_stall": drill_overlap_stall,
     "spec_reject_storm": drill_spec_reject_storm,
     "replica_failover": drill_replica_failover,
+    "crash_resume": drill_crash_resume,
 }
 
 
