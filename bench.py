@@ -615,6 +615,84 @@ def _bench_sched_prefix(cfg, slots=4, max_new=96):
     return total / elapsed, reused
 
 
+def _bench_sched_pressure(cfg, slots=4, max_new=96):
+    """KV-tiering serving throughput under page pressure (runtime/
+    kvtier.py + scheduler grow ladder): the ``-sched4`` staggered
+    workload on a paged pool deliberately sized at ~40% of what full
+    reservation would demand, with ``--kv-reserve optimistic`` so every
+    request seats on prompt-sized pages and grows page-by-page at
+    decode.  The pool cannot hold all four requests resident, so the
+    grow ladder spills idle-longest victims to the host pool and pages
+    them back in as neighbors retire — the run measures what that
+    thrash costs relative to an uncontended pool (``-sched4``), while
+    greedy decode stays byte-identical.  A full-reservation scheduler
+    on this pool could not even admit the workload concurrently.
+    Returns (aggregate tok/s, pages spilled, pages paged back in)."""
+    import threading
+
+    import jax
+    import numpy as np
+    from dllama_tpu.parallel.mesh import make_mesh
+    from dllama_tpu.runtime.engine import Engine
+    from dllama_tpu.runtime.scheduler import SlotScheduler
+
+    params = maybe_blocked(_zero_q40_params(cfg))
+    page_size = 16
+    rng = np.random.RandomState(7)
+    prompts = [[int(t) for t in rng.randint(1, cfg.vocab_size, 8 + 4 * i)]
+               for i in range(slots)]
+    # full-reservation demand for this workload, then size the pool at
+    # 40% of it (+1 for the scratch page): optimistic reservation must
+    # serve out of a pool that full reservation could not seat
+    full_pages = sum(-(-min(len(p) + max_new, cfg.seq_len) // page_size)
+                     for p in prompts)
+    worst = max(-(-min(len(p) + max_new, cfg.seq_len) // page_size)
+                for p in prompts)
+    kv_pages = max(int(0.4 * full_pages), worst) + 1
+    eng = Engine(cfg, params,
+                 mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+                 batch=slots,
+                 kv_pages=kv_pages, kv_page_size=page_size)
+    sched = SlotScheduler(eng, prefill_chunk=16, max_wait_ms=20.0,
+                          kv_reserve="optimistic", spill_headroom=16,
+                          host_pool_mb=64.0)
+    counts = [0] * slots
+
+    def run(i, delay):
+        time.sleep(delay)
+        t = sched.submit(prompts[i], max_new)
+        counts[i] = sum(1 for _ in t.tokens())
+
+    def wave(stagger):
+        ths = [threading.Thread(target=run, args=(i, stagger * i))
+               for i in range(slots)]
+        t0 = time.perf_counter()
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join()
+        return time.perf_counter() - t0
+
+    from dllama_tpu.obs import metrics as obs_metrics
+    t0 = time.perf_counter()
+    wave(0.05)  # compile + warmup: same stagger, so the same shape set
+    print(f"compile+warmup: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+    spilled0 = obs_metrics.KV_PAGES_SPILLED.value
+    paged_in0 = obs_metrics.KV_PAGES_PAGED_IN.value
+    elapsed = wave(0.05)
+    spilled = obs_metrics.KV_PAGES_SPILLED.value - spilled0
+    paged_in = obs_metrics.KV_PAGES_PAGED_IN.value - paged_in0
+    sched.pool.check()
+    sched.close()
+    total = sum(counts)
+    print(f"bench: sched-pressure {total} tokens over {slots} staggered "
+          f"requests on a {kv_pages - 1}-page pool ({full_pages} pages of "
+          f"full-reservation demand) in {elapsed:.2f}s "
+          f"({spilled} pages spilled, {paged_in} paged back in)",
+          file=sys.stderr)
+    return total / elapsed, int(spilled), int(paged_in)
+
+
 def _bench_sched_overlap(cfg, slots=4, max_new=96):
     """Overlapped-dispatch A/B (the two-deep pipeline in
     runtime/scheduler.py): ``slots`` short prompts submitted together so
@@ -919,6 +997,35 @@ def run_attempt(name):
             "host_gap_share_on": round(on["host_gap_share"], 4),
             "host_gap_share_off": round(off["host_gap_share"], 4),
             "hidden_host_ms_on": round(on["hidden_host_ms"], 1),
+            "backend": jax.default_backend()}))
+        return
+
+    if name.endswith("-pressure4"):
+        # KV tiering under page pressure (runtime/kvtier.py): the -sched4
+        # workload on a pool at ~40% of full-reservation demand, served
+        # with optimistic reservation + host spill — the tok/s gap vs
+        # -sched4 is what over-commit thrash costs; full reservation
+        # could not run this workload concurrently at all
+        base = name[:-10]
+        cfg = _model_cfg(base)
+        if base == "cpu-tiny":
+            impl = "xla"
+        else:
+            print(f"bench: {base}: claiming backend...", file=sys.stderr)
+            print(f"bench: {base}: backend {jax.default_backend()}",
+                  file=sys.stderr)
+            impl = _pallas_hw_check("q40")
+        toks, spilled, paged_in = _bench_sched_pressure(
+            cfg.with_(quant_impl=impl))
+        print(json.dumps({
+            "metric": f"{base} q40 KV-tiering slots=4 aggregate decode "
+                      f"tok/s (optimistic reservation, pool at 40% of "
+                      f"full demand, {impl})",
+            "value": round(toks, 2), "unit": "tok/s",
+            "vs_baseline": round(toks / BASELINE_7B_TOKS, 2)
+            if base == "llama2-7b" else None,
+            "spill_pages": spilled,
+            "pagein_pages": paged_in,
             "backend": jax.default_backend()}))
         return
 
@@ -1476,6 +1583,22 @@ def main():
                     px_out.get("prefix_tokens_reused")
                 print(f"bench: prefix sharing: {json.dumps(px_out)}",
                       file=sys.stderr)
+        # KV-tiering evidence: the sched4 workload on a pool at 40% of
+        # full-reservation demand, optimistic reservation + host spill —
+        # the ratio vs the sched4 row is what over-commit thrash costs
+        # on a pool full reservation could not serve concurrently
+        if got_7b and remaining() > RESERVE + 280 and _relay_up():
+            pr_out = _spawn("llama2-7b-pressure4", 300)
+            if pr_out:
+                extras["llama2-7b_pressure4_agg_toks"] = pr_out["value"]
+                extras["llama2-7b_pressure4_spill_pages"] = \
+                    pr_out.get("spill_pages")
+                sc_toks = extras.get("llama2-7b_sched4_agg_toks")
+                if sc_toks:
+                    extras["llama2-7b_pressure4_vs_sched4"] = round(
+                        pr_out["value"] / sc_toks, 3)
+                print(f"bench: KV tiering: {json.dumps(pr_out)}",
+                      file=sys.stderr)
         # tensor-parallel serving evidence: the sched4 workload on a tp=4
         # mesh (4 chips) with the fused collective-matmul decode — the
         # dispatch ledger in the attempt's stderr says whether the ring
@@ -1655,6 +1778,20 @@ def main():
                 extras["cpu_prefix4_agg_toks"] = px["value"]
                 extras["cpu_prefix4_tokens_reused"] = \
                     px.get("prefix_tokens_reused")
+        if remaining() > 140:
+            # KV tiering on the same CPU backend: the sched4 workload on
+            # a pool at 40% of full-reservation demand — optimistic
+            # reservation + host spill keep it serving (byte-identical
+            # greedy decode); the ratio vs sched4 is the thrash cost
+            pr = _spawn("cpu-tiny-pressure4", min(remaining() - 60, 360),
+                        env_extra=cpu_env)
+            if pr and pr.get("value"):
+                extras = extras or {}
+                extras["cpu_pressure4_agg_toks"] = pr["value"]
+                extras["cpu_pressure4_spill_pages"] = pr.get("spill_pages")
+                if extras.get("cpu_sched4_agg_toks"):
+                    extras["cpu_pressure4_vs_sched4"] = round(
+                        pr["value"] / extras["cpu_sched4_agg_toks"], 2)
         if remaining() > 140:
             # tensor-parallel serving on the same host: the sched4
             # workload on a tp=4 mesh over 8 forced virtual devices —

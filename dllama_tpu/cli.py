@@ -183,6 +183,35 @@ def build_parser() -> argparse.ArgumentParser:
                    help="paged KV: tokens per page; prefix sharing works in "
                         "whole pages, so smaller pages share more of a "
                         "common prompt but make longer page tables")
+    p.add_argument("--kv-reserve", choices=("full", "optimistic"),
+                   default="full",
+                   help="paged KV: page reservation policy.  'full' "
+                        "reserves every page a request can ever touch at "
+                        "admission (exhaustion = queueing, spill never "
+                        "engages); 'optimistic' admits with only "
+                        "ceil((prompt + --spill-headroom)/page) pages and "
+                        "grows slots page-by-page at decode, reclaiming "
+                        "through radix eviction and host-RAM spill under "
+                        "pressure (docs/PERF.md KV tiering)")
+    p.add_argument("--spill-headroom", type=int, default=16,
+                   help="optimistic KV reservation: decode tokens of "
+                        "slack reserved beyond the prompt at admission "
+                        "(and at preempt-resume); larger values grow "
+                        "less often, smaller ones admit more "
+                        "concurrently")
+    p.add_argument("--kv-host-pool-mb", type=float, default=64.0,
+                   help="KV tiering: pinned host-RAM budget (MiB) for "
+                        "spilled KV pages; a spill that would not fit "
+                        "falls back to preempt/park (0 disables "
+                        "spilling entirely)")
+    p.add_argument("--kv-quant", choices=("off", "int8"), default="off",
+                   help="paged KV: store pages quantized int8 with "
+                        "per-page scales (~half the pool bytes of bf16); "
+                        "attention dequantizes fused at read "
+                        "(dispatch ledger codec kv_int8).  Snapshots "
+                        "and DLREQ01 hand-off records carry the codec; "
+                        "geometry-compatible peers with a different "
+                        "codec reject cleanly")
     p.add_argument("--no-prefix-reuse", action="store_true",
                    help="paged KV: disable the radix prefix cache (pages "
                         "are still pooled; nothing is shared or retained "

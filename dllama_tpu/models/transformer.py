@@ -88,7 +88,7 @@ def init_kv_cache(cfg: ModelConfig, batch: int, seq_len: int | None = None,
 
 
 def init_kv_pool(cfg: ModelConfig, n_pages: int, page_size: int,
-                 dtype=None) -> KVCache:
+                 dtype=None, quant: bool = False) -> KVCache:
     """Paged KV pool: the stacked layout with the batch axis generalized
     to physical pages and the sequence axis shrunk to one page —
     ``(L, n_pages, Hkv, page_size, Dh)``.  Axis-for-axis compatible with
@@ -96,8 +96,20 @@ def init_kv_pool(cfg: ModelConfig, n_pages: int, page_size: int,
     page interior rides the sequence axis).  Page 0 is the reserved
     scratch page (see ops.attention paged section); slots address the
     pool through per-slot page tables, so pool memory is bounded by live
-    *tokens*, not slots × max-seq."""
+    *tokens*, not slots × max-seq.
+
+    ``quant=True`` (``--kv-quant int8``) stores int8 values plus a
+    per-(page, head, position) f32 scale plane ``(L, P, Hkv, ps, 1)`` —
+    the page-granular mirror of the contiguous quantized cache's codec
+    (same quantize_kv absmax math, same ~2× HBM saving), so a pool page
+    is self-describing: values and scales always travel together through
+    spills, snapshots and DLREQ01 hand-offs."""
     shape = (cfg.n_layers, n_pages, cfg.n_kv_heads, page_size, cfg.head_size)
+    if quant:
+        sshape = shape[:-1] + (1,)
+        return KVCache(jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                       jnp.zeros(sshape, jnp.float32),
+                       jnp.zeros(sshape, jnp.float32))
     dt = dtype or cfg.dtype
     return KVCache(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
 
@@ -169,11 +181,26 @@ def _attention_block(x, lp, cfg: ModelConfig, cache: KVCache, cos, sin, pos,
             # through the page table (write indices precomputed once in
             # forward_slots — identical for every layer)
             page_table, pidx, oidx = paged
-            ck, cv = paged_update_kv_rows(cache.k, cache.v, k, v, layer,
-                                          pidx, oidx)
-            cache = KVCache(ck, cv)
-            att = paged_gqa_attention_at(q, cache.k, cache.v, layer,
-                                         page_table, pos_rows)
+            if cache.quantized:
+                # int8 pages: quantize the step window once, scatter
+                # values and per-position scales through the same write
+                # indices, and let attention dequantize on read
+                qk, sk = quantize_kv(k)
+                qv, sv = quantize_kv(v)
+                ck, cv = paged_update_kv_rows(cache.k, cache.v, qk, qv,
+                                              layer, pidx, oidx)
+                csk, csv = paged_update_kv_rows(cache.k_scale, cache.v_scale,
+                                                sk, sv, layer, pidx, oidx)
+                cache = KVCache(ck, cv, csk, csv)
+                att = paged_gqa_attention_at(
+                    q, cache.k, cache.v, layer, page_table, pos_rows,
+                    scales=(cache.k_scale, cache.v_scale))
+            else:
+                ck, cv = paged_update_kv_rows(cache.k, cache.v, k, v, layer,
+                                              pidx, oidx)
+                cache = KVCache(ck, cv)
+                att = paged_gqa_attention_at(q, cache.k, cache.v, layer,
+                                             page_table, pos_rows)
         else:
             ck, cv = update_kv_cache_rows(cache.k, cache.v, k, v, layer,
                                           pos_rows)

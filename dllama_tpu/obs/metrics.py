@@ -596,6 +596,33 @@ KV_POOL_EXHAUSTED = REGISTRY.counter(
     "Admissions deferred because the page pool had no free pages (the "
     "request waits queued until retirements free pages).")
 
+# KV memory tiering (runtime/kvtier.py, --kv-reserve optimistic): under
+# pressure a mid-decode grow evicts cold radix entries and spills the
+# idle-longest slot's pages to the pinned host-RAM pool; spilled slots
+# page back in on demand.  Spill/page-in counters are page-granular; the
+# host-pool gauge is the live byte footprint of spilled KV; the codec
+# gauge names the active page format (bf16/f32/int8) exactly once.
+KV_PAGES_SPILLED = REGISTRY.counter(
+    "kv_pages_spilled",
+    "KV pages copied device-to-host and freed by the tiering policy "
+    "(--kv-reserve optimistic under pool pressure).")
+KV_PAGES_PAGED_IN = REGISTRY.counter(
+    "kv_pages_paged_in",
+    "Spilled KV pages copied back host-to-device when their slot "
+    "rejoined the dispatch.")
+KV_SPILL_BYTES = REGISTRY.counter(
+    "kv_spill_bytes",
+    "Bytes of KV page data moved device-to-host by spills (values plus "
+    "per-position scale planes for int8 pages).")
+KV_HOST_POOL_BYTES = REGISTRY.gauge(
+    "kv_host_pool_bytes",
+    "Bytes of spilled KV currently resident in the host-RAM pool "
+    "(bounded by --kv-host-pool-mb).")
+KV_PAGE_CODEC = REGISTRY.labeled_gauge(
+    "kv_page_codec", "codec",
+    "Active paged-KV page format (1 for the engine's codec: the pool "
+    "dtype, e.g. bfloat16, or int8 under --kv-quant int8).")
+
 # device-memory telemetry: per-device HBM gauges.  The reader fn is bound
 # by runtime/engine.py at import (jax stays out of the obs package);
 # backends without memory_stats (CPU) expose an empty family, not zeros.

@@ -212,28 +212,46 @@ def paged_update_kv_rows(pool_k: jax.Array, pool_v: jax.Array,
 
 
 def paged_gather_layer(pool: jax.Array, layer: jax.Array,
-                       page_table: jax.Array) -> jax.Array:
+                       page_table: jax.Array,
+                       scale_pool: jax.Array | None = None) -> jax.Array:
     """Materialize one layer's logical KV view (B, Hkv, maxp·ps, Dh) by
     gathering each slot's pages from the pool (L, P, Hkv, ps, Dh).  The
     gather is the paged twin of the contiguous layer slice: XLA fuses it
     into the score dot for the short-cache one-shot path, and the
-    long-cache decode path avoids it entirely (page-walk fold)."""
+    long-cache decode path avoids it entirely (page-walk fold).
+
+    ``scale_pool``: the int8 pool's per-position scale planes
+    (L, P, Hkv, ps, 1) — the gather stays int8-sized and the dequant
+    multiply fuses into the downstream dot like the plain cast."""
     pl = jax.lax.dynamic_index_in_dim(pool, layer, 0, keepdims=False)
     view = pl[page_table]  # (B, maxp, Hkv, ps, Dh)
     b, maxp, hkv, ps, dh = view.shape
-    return view.transpose(0, 2, 1, 3, 4).reshape(b, hkv, maxp * ps, dh)
+    out = view.transpose(0, 2, 1, 3, 4).reshape(b, hkv, maxp * ps, dh)
+    if scale_pool is None:
+        return out
+    sl = jax.lax.dynamic_index_in_dim(scale_pool, layer, 0, keepdims=False)
+    sview = sl[page_table]  # (B, maxp, Hkv, ps, 1)
+    sc = sview.transpose(0, 2, 1, 3, 4).reshape(b, hkv, maxp * ps, 1)
+    return dequant_kv(out, sc)
 
 
 def paged_decode_attention(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
                            layer: jax.Array, page_table: jax.Array,
-                           pos_rows: jax.Array) -> jax.Array:
+                           pos_rows: jax.Array,
+                           scales: tuple[jax.Array, jax.Array] | None = None
+                           ) -> jax.Array:
     """Single-token decode over the paged pool that walks only live pages:
     :func:`blocked_live_fold` with the page as the block (the pool already
     stores fixed-size KV chunks — pages ARE the fold's block granularity)
     and one pool gather per step in place of the contiguous block slice.
     Per-row ceilings ride the fold's ``row_pos`` mask; rows whose table
     runs out before the longest neighbor read scratch page 0, fully
-    masked."""
+    masked.
+
+    ``scales``: the int8 pool's (k, v) scale planes (L, P, Hkv, ps, 1) —
+    each fold step gathers the value page AND its scale page and
+    dequantizes after the int8-sized HBM read (the point of the
+    quantized pool)."""
     b, hq, t, dh = q.shape
     hkv = pool_k.shape[2]
     ps = pool_k.shape[3]
@@ -248,7 +266,20 @@ def paged_decode_attention(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
         # page gather per fold step — never the whole layer slab
         return pool[layer.astype(jnp.int32), pid]
 
-    _, l, acc = blocked_live_fold(qf, slice_page, pool_k, pool_v,
+    if scales is None:
+        kc_arg, vc_arg = pool_k, pool_v
+        sl = slice_page
+    else:
+        ks, vs = scales
+
+        def sl(pair, start, length):
+            vals, sc = pair
+            return dequant_kv(slice_page(vals, start, length),
+                              slice_page(sc, start, length))
+
+        kc_arg, vc_arg = (pool_k, ks), (pool_v, vs)
+
+    _, l, acc = blocked_live_fold(qf, sl, kc_arg, vc_arg,
                                   jnp.max(pos_rows), jnp.int32(0), maxp * ps,
                                   row_pos=pos_rows, block=ps)
     out = acc / jnp.maximum(l, 1e-38)[..., None]
@@ -257,22 +288,36 @@ def paged_decode_attention(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
 
 def paged_gqa_attention_at(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
                            layer: jax.Array, page_table: jax.Array,
-                           pos_rows: jax.Array) -> jax.Array:
+                           pos_rows: jax.Array,
+                           scales: tuple[jax.Array, jax.Array] | None = None
+                           ) -> jax.Array:
     """Causal GQA read through the page-table indirection at ``layer``,
     with the slot path's per-row causal ceiling.  Dispatch mirrors the
     contiguous path: long-cache single-token decode walks live pages
     (:func:`paged_decode_attention`, O(max pos) traffic); everything else
     gathers the logical view and reuses the one-shot slot math, so paged
     and contiguous reads are the same computation over the same logical
-    keys."""
+    keys.
+
+    ``scales``: the int8-pool (k, v) scale planes (L, P, Hkv, ps, 1);
+    both dispatch arms dequantize after the int8-sized page read."""
     t = q.shape[2]
     ps = pool_k.shape[3]
     s = page_table.shape[1] * ps
+    if scales is not None:
+        # trace-time ledger entry like the q40/q8 matmul paths: an int8
+        # paged read is a codec decision a bench number must not hide
+        from ..obs import dispatch as obs_dispatch
+        obs_dispatch.record_dispatch(
+            "kv_int8",
+            "paged-decode" if _use_blocked_decode(t, s) else "paged-gather",
+            t=t, s=s, page_size=ps)
     if _use_blocked_decode(t, s):
         return paged_decode_attention(q, pool_k, pool_v, layer, page_table,
-                                      pos_rows)
-    k_l = paged_gather_layer(pool_k, layer, page_table)
-    v_l = paged_gather_layer(pool_v, layer, page_table)
+                                      pos_rows, scales=scales)
+    ks, vs = scales if scales is not None else (None, None)
+    k_l = paged_gather_layer(pool_k, layer, page_table, scale_pool=ks)
+    v_l = paged_gather_layer(pool_v, layer, page_table, scale_pool=vs)
     return _rows_ceiling_attention(q, k_l, v_l, pos_rows)
 
 

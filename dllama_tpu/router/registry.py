@@ -219,7 +219,14 @@ class Registry:
         score = float(free_slots if free_slots is not None else 0)
         score -= float(cap.get("queue_depth") or 0)
         score -= float(b.in_flight)
-        free_pages = cap.get("free_kv_pages")
+        # KV tiebreak: prefer the tiering view when the replica reports
+        # one — resident free pages plus pages reclaimable by spilling
+        # idle slots (kv_pressure.effective_free) — falling back to the
+        # plain free list for pre-tiering replicas
+        kvp = cap.get("kv_pressure") or {}
+        free_pages = kvp.get("effective_free")
+        if free_pages is None:
+            free_pages = cap.get("free_kv_pages")
         if free_pages is not None:
             # tiebreak only: a page is worth far less than a slot
             score += min(float(free_pages), 1e5) * 1e-6

@@ -336,9 +336,6 @@ class Engine:
             if self.sp > 1:
                 raise ValueError("paged KV is not supported on sp meshes "
                                  "(sequence-sharded pools are not wired)")
-            if kv_quant:
-                raise ValueError("paged KV needs a dense cache dtype "
-                                 "(per-page quantized writes are not wired)")
             if self.kv_pages < 2:
                 raise ValueError("kv_pages must be >= 2 (page 0 is the "
                                  "reserved scratch page)")
@@ -351,10 +348,18 @@ class Engine:
             # pool layout (L, P, Hkv, ps, Dh) is axis-compatible with the
             # contiguous cache spec: pages ride the batch ("dp") axis, the
             # page interior rides the sequence axis
+            # --kv-quant int8: pool pages hold int8 values + per-position
+            # f32 scale planes (the Q80 weight codec's trick applied to
+            # pages); paged attention dequantizes after the int8-sized
+            # page read, so cache HBM traffic and residency halve again
+            # on top of paging
             self.cache = jax.device_put(
                 init_kv_pool(cfg, self.kv_pages, self.kv_page_size,
-                             dtype=kv_dtype),
+                             dtype=None if kv_quant else kv_dtype,
+                             quant=kv_quant),
                 self._cache_sh)
+            obs_metrics.KV_PAGE_CODEC.set(
+                "int8" if kv_quant else str(self.cache.k.dtype), 1)
         else:
             self.cache = jax.device_put(
                 init_kv_cache(cfg, batch, self.seq_len,
@@ -547,8 +552,11 @@ class Engine:
             "n_active_experts": c.n_active_experts,
             "vocab_size": c.vocab_size, "hidden_act": c.hidden_act,
             "rope_theta": c.rope_theta, "seq_len": self.seq_len,
-            # page shape (Hkv, ps, Dh) + dtype, not pool page count
+            # page shape (Hkv, ps, Dh) + dtype, not pool page count; the
+            # codec is explicit so int8-paged vs dense records reject
+            # cleanly even where the raw dtype string would coincide
             "page": [str(k.dtype), list(k.shape[2:])],
+            "codec": "int8" if self.cache.quantized else "dense",
             "handoff": 1,
         }
         return snapfmt.fingerprint(fields)
@@ -597,11 +605,40 @@ class Engine:
 
     def read_pool_pages(self, pages) -> dict[str, np.ndarray]:
         """Copy the given physical pages out of the paged pool to host
-        numpy, all layers at once: shape ``(L, n, Hkv, ps, Dh)``.  Used
-        by the scheduler's drain-time export."""
-        idx = np.asarray(pages, np.int32)
-        return {"pages.k": np.asarray(self.cache.k)[:, idx],
-                "pages.v": np.asarray(self.cache.v)[:, idx]}
+        numpy, all layers at once: shape ``(L, n, Hkv, ps, Dh)`` (plus the
+        ``(L, n, Hkv, ps, 1)`` scale planes for an int8 pool).  Used by
+        the scheduler's drain-time export and the spill path."""
+        return {k: h.wait() for k, h in
+                self.read_pool_pages_async(pages).items()}
+
+    def read_pool_pages_async(self, pages) -> dict:
+        """Start device-to-host copies of the given physical pages and
+        return ``{name: handle}`` where ``handle.wait()`` yields the host
+        ndarray.  The gather is enqueued on the device stream behind
+        whatever is already in flight and ``copy_to_host_async`` makes
+        the D2H transfer non-blocking — the spill path issues the copies,
+        does its host-side bookkeeping, and only ``wait()``s right before
+        freeing the pages, so the transfer hides behind the next dispatch
+        burst."""
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+
+        class _Handle:
+            def __init__(self, dev):
+                self._dev = dev
+                try:
+                    dev.copy_to_host_async()
+                except Exception:
+                    pass  # backend without async D2H: wait() still works
+
+            def wait(self):
+                return np.asarray(self._dev)
+
+        out = {"pages.k": _Handle(self.cache.k[:, idx]),
+               "pages.v": _Handle(self.cache.v[:, idx])}
+        if self.cache.quantized:
+            out["pages.k_scale"] = _Handle(self.cache.k_scale[:, idx])
+            out["pages.v_scale"] = _Handle(self.cache.v_scale[:, idx])
+        return out
 
     def write_pool_pages(self, pages, arrays: dict[str, np.ndarray]) -> None:
         """Write exported page slices (from :meth:`read_pool_pages` on a
@@ -614,7 +651,17 @@ class Engine:
             jnp.asarray(arrays["pages.k"], self.cache.k.dtype))
         new_v = self.cache.v.at[:, idx].set(
             jnp.asarray(arrays["pages.v"], self.cache.v.dtype))
-        self.cache = jax.device_put(KVCache(new_k, new_v), self._cache_sh)
+        if self.cache.quantized:
+            new_ks = self.cache.k_scale.at[:, idx].set(
+                jnp.asarray(arrays["pages.k_scale"],
+                            self.cache.k_scale.dtype))
+            new_vs = self.cache.v_scale.at[:, idx].set(
+                jnp.asarray(arrays["pages.v_scale"],
+                            self.cache.v_scale.dtype))
+            cache = KVCache(new_k, new_v, new_ks, new_vs)
+        else:
+            cache = KVCache(new_k, new_v)
+        self.cache = jax.device_put(cache, self._cache_sh)
 
     def _sync(self, arrays, what: str) -> list[str]:
         """Block until ``arrays`` are device-ready — THE engine's blocking
@@ -1182,9 +1229,10 @@ class Engine:
         if self.sp > 1:
             raise ValueError("slot serving is not supported on sp meshes "
                              "(sequence-sharded cache); use sp=1")
-        if self.cache.quantized:
-            raise ValueError("slot serving needs a dense KV cache "
-                             "(per-row quantized writes are not wired)")
+        if self.cache.quantized and not self.paged:
+            raise ValueError("slot serving needs a dense or paged-int8 KV "
+                             "cache (contiguous per-row quantized writes "
+                             "are not wired)")
         if self.paged and page_tables_np is None:
             raise ValueError("paged engine: slot_step needs page_tables_np")
         if not self.paged and page_tables_np is not None:
@@ -1292,9 +1340,10 @@ class Engine:
         if self.sp > 1:
             raise ValueError("slot serving is not supported on sp meshes "
                              "(sequence-sharded cache); use sp=1")
-        if self.cache.quantized:
-            raise ValueError("slot serving needs a dense KV cache "
-                             "(per-row quantized writes are not wired)")
+        if self.cache.quantized and not self.paged:
+            raise ValueError("slot serving needs a dense or paged-int8 KV "
+                             "cache (contiguous per-row quantized writes "
+                             "are not wired)")
         if self.paged and page_tables_np is None:
             raise ValueError("paged engine: slot_verify needs page_tables_np")
         if not self.paged and page_tables_np is not None:

@@ -34,6 +34,15 @@ Fault points (the arming side never needs code changes to add more —
   (runtime/engine.py, ``--numeric-checks``); ``nan`` poisons the checked
   logits so the ``NumericFault`` path is testable without real
   corruption.
+* ``kv.spill``              — in the KV tiering path (runtime/
+  scheduler.py, ``_spill_slot_locked``) before a victim slot's pages
+  move to the host pool; a ``delay`` here is a slow D2H drain (the
+  spilled consumer's stall window), a ``raise`` aborts the spill and
+  the grow ladder falls back to preemption — honest queueing either
+  way, never wrong bytes.
+* ``sched.host_fanout``     — in the slot scheduler's token fanout
+  (runtime/scheduler.py) after a dispatch lands; a ``delay`` here
+  widens the host gap the overlapped pipeline must hide.
 * ``pod.respawn``           — in the serve-pod supervisor
   (router/pod.py) before a dead/hung replica is respawned; a
   ``raise``/``delay`` here is a respawn that fails or stalls, the

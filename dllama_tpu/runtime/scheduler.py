@@ -130,6 +130,9 @@ class Ticket:
         self.priority = int(priority)
         self.preempt_count = 0
         self.parked_ms = 0.0
+        # KV tiering: total ms this request's pages sat in the host spill
+        # pool (the stall the flight record surfaces as ``spill_ms``)
+        self.spill_ms = 0.0
         # hand-off state (runtime/snapshot.py DLREQ01): the server parks
         # its stop strings here so a drain-time export can ship them, and
         # every emitted completion token is kept so the importing replica
@@ -172,7 +175,8 @@ class Ticket:
 
 class _Slot:
     __slots__ = ("ticket", "pos", "fed", "produced", "last", "pages",
-                 "prefix_tokens", "inserted")
+                 "prefix_tokens", "inserted", "budget", "spilled",
+                 "active_at")
 
     def __init__(self):
         self.ticket: Ticket | None = None
@@ -183,6 +187,14 @@ class _Slot:
         self.pages: list[int] = []   # paged mode: owned pool pages
         self.prefix_tokens = 0       # prompt tokens bound from the radix tree
         self.inserted = False        # prompt pages handed to the tree yet?
+        # KV tiering (--kv-reserve optimistic): the page ceiling this
+        # request can ever need, the non-resident flag (pages spilled to
+        # the host pool; the slot sits out dispatches until they page
+        # back in), and the victim-ranking clock (monotonic of the last
+        # token this slot advanced — idle-longest spills first)
+        self.budget = 0
+        self.spilled = False
+        self.active_at = 0.0
 
 
 class _Parked:
@@ -230,11 +242,17 @@ class SlotScheduler:
                  preempt_age_ms: float = 5000.0, preempt_cap: int = 3,
                  parked_max: int | None = None,
                  spill_dir: str | None = None,
-                 spec=None, spec_k: int = 4):
+                 spec=None, spec_k: int = 4,
+                 kv_reserve: str = "full", spill_headroom: int = 16,
+                 host_pool_mb: float = 64.0):
         if engine.sp > 1:
             raise ValueError("slot scheduling is not supported on sp meshes")
-        if engine.cache.quantized:
-            raise ValueError("slot scheduling needs a dense KV cache")
+        if engine.cache.quantized and not getattr(engine, "paged", False):
+            raise ValueError("slot scheduling needs a dense or paged-int8 "
+                             "KV cache")
+        if kv_reserve not in ("full", "optimistic"):
+            raise ValueError(f"kv_reserve must be 'full' or 'optimistic', "
+                             f"got {kv_reserve!r}")
         self.engine = engine
         self.slots = [_Slot() for _ in range(engine.batch)]
         self.prefill_chunk = max(1, int(prefill_chunk))
@@ -250,12 +268,34 @@ class SlotScheduler:
         self.paged = bool(getattr(engine, "paged", False))
         self.pool: PagePool | None = None
         self.prefix_cache: RadixTree | None = None
+        # KV tiering (runtime/kvtier.py): under ``optimistic`` reservation
+        # admission binds only ceil((prompt + spill_headroom)/page) pages
+        # and slots grow page-by-page between dispatch rounds; a grow that
+        # finds the pool empty spills the idle-longest neighbor's pages to
+        # the bytes-bounded host pool and pages them back in on demand.
+        # ``full`` keeps today's whole-request reservation (spill never
+        # engages — every slot is always resident).
+        self.kv_reserve = kv_reserve
+        self.optimistic = self.paged and kv_reserve == "optimistic"
+        self.spill_headroom = max(0, int(spill_headroom))
+        self.host_pool = None
+        self._spilled: dict[int, dict] = {}   # slot -> spill bookkeeping
+        self._page_nbytes = 0
         if self.paged:
             self.pool = PagePool(engine.kv_pages, engine.kv_page_size)
             if prefix_reuse:
                 self.prefix_cache = RadixTree(self.pool)
             self._page_tables = np.zeros(
                 (engine.batch, engine.max_pages_per_slot), np.int32)
+            from .kvtier import HostPagePool
+            self.host_pool = HostPagePool(
+                int(float(host_pool_mb) * (1 << 20)))
+            cache = engine.cache
+            planes = (cache.k, cache.v) + (
+                (cache.k_scale, cache.v_scale) if cache.quantized else ())
+            self._page_nbytes = sum(
+                int(np.prod(a.shape[:1] + a.shape[2:])) * a.dtype.itemsize
+                for a in planes)
             obs_metrics.KV_PAGES_TOTAL.set(self.pool.capacity)
             obs_metrics.KV_PAGES_IN_USE.set(0)
         self._queue: deque[Ticket] = deque()
@@ -377,6 +417,29 @@ class SlotScheduler:
                 out["kv_pages_free"] = self.pool.available
                 if self.prefix_cache is not None:
                     out["prefix_nodes"] = len(self.prefix_cache)
+                # tiering pressure for the fleet router: resident free
+                # pages plus what one spill pass could free into the host
+                # pool — the capacity a new request can actually claim
+                owned = sum(len(s.pages) for s in self.slots
+                            if s.ticket is not None and not s.spilled)
+                headroom = 0
+                if self.host_pool is not None and self._page_nbytes:
+                    headroom = max(0, self.host_pool.capacity_bytes
+                                   - self.host_pool.bytes_used) \
+                        // self._page_nbytes
+                spillable = min(owned, headroom) if self.optimistic else 0
+                eng = self.engine
+                out["kv_pressure"] = {
+                    "reserve": self.kv_reserve,
+                    "resident_free": self.pool.available,
+                    "spillable": spillable,
+                    "effective_free": self.pool.available + spillable,
+                    "host_pool_bytes": self.host_pool.bytes_used
+                    if self.host_pool is not None else 0,
+                    "spilled_slots": len(self._spilled),
+                    "codec": "int8" if eng.cache.quantized
+                    else str(eng.cache.k.dtype),
+                }
             return out
 
     def begin_drain(self, deadline: float | None) -> None:
@@ -500,6 +563,10 @@ class SlotScheduler:
             if self.prefix_cache is not None:
                 self.prefix_cache = RadixTree(self.pool)
                 self.prefix_cache.restore(extra.get("radix") or [])
+            # spill records describe the pre-restore pool; drop them
+            if self.host_pool is not None:
+                self.host_pool.clear()
+            self._spilled.clear()
             obs_metrics.KV_PAGES_IN_USE.set(self.pool.in_use)
             return extra
 
@@ -522,11 +589,25 @@ class SlotScheduler:
             deadline_left = max(t.deadline - time.monotonic(), 0.0)
         # pages may contain stale values above pos (an in-flight dispatch
         # whose fanout never ran) — harmless, the importer's causal
-        # ceiling masks them exactly like slot reuse does
-        with self._engine_lock:
-            arrays = self.engine.read_pool_pages(s.pages[:n_data])
-            arrays["rng_key"] = np.asarray(self.engine._key)
-            chunk_counter = self.engine._chunk_counter
+        # ceiling masks them exactly like slot reuse does.  A spilled
+        # slot's pages are not resident: its record is built from the
+        # host-pool copy (page order there is the slot's logical order).
+        if s.spilled:
+            rec = self.host_pool.get(self._spill_key(slot_idx))
+            if rec is None:
+                raise RuntimeError(
+                    f"slot {slot_idx} marked spilled but its host-pool "
+                    "record is missing")
+            arrays = {name: np.asarray(a[:, :n_data])
+                      for name, a in rec[0].items()}
+            with self._engine_lock:
+                arrays["rng_key"] = np.asarray(self.engine._key)
+                chunk_counter = self.engine._chunk_counter
+        else:
+            with self._engine_lock:
+                arrays = self.engine.read_pool_pages(s.pages[:n_data])
+                arrays["rng_key"] = np.asarray(self.engine._key)
+                chunk_counter = self.engine._chunk_counter
         from . import snapshot as snapfmt
         return snapfmt.dumps_request(
             fingerprint=self.engine.handoff_fingerprint(),
@@ -539,7 +620,7 @@ class SlotScheduler:
                 "deadline_left": deadline_left,
                 "fed": s.fed, "produced": s.produced, "last": s.last,
                 "priority": t.priority, "preempt_count": t.preempt_count,
-                "parked_ms": t.parked_ms,
+                "parked_ms": t.parked_ms, "spill_ms": t.spill_ms,
             })
 
     def handoff_export_all(self) -> dict[str, bytes]:
@@ -662,16 +743,25 @@ class SlotScheduler:
                 "inconsistent request state in hand-off record")
         ps = self.pool.page_size
         n_data = -(-pos // ps)
-        pk, pv = arrays.get("pages.k"), arrays.get("pages.v")
-        kvshape = eng.cache.k.shape
-        want_shape = (kvshape[0], n_data) + tuple(kvshape[2:])
-        for name, arr in (("pages.k", pk), ("pages.v", pv)):
+        # the record must carry exactly this pool's page planes: values
+        # always, per-position scale planes iff the pool is int8 — an
+        # int8 record into a dense pool (or vice versa) already failed
+        # the fingerprint above, this validates shape against position
+        page_names = ["pages.k", "pages.v"]
+        if eng.cache.quantized:
+            page_names += ["pages.k_scale", "pages.v_scale"]
+        page_arrays: dict = {}
+        for name in page_names:
+            ref = getattr(eng.cache, name.split(".", 1)[1])
+            arr = arrays.get(name)
+            want_shape = (ref.shape[0], n_data) + tuple(ref.shape[2:])
             if arr is None or tuple(arr.shape) != want_shape:
                 raise snapfmt.SnapshotMismatch(
                     "<handoff record>", f"array {name!r}",
                     "page payload does not match the record position",
                     expected=str(want_shape),
                     got="missing" if arr is None else str(arr.shape))
+            page_arrays[name] = arr
         need = min(len(prompt) + max_new, eng.seq_len)
         n_total = -(-need // ps)
         if n_total > self.pool.capacity:
@@ -705,8 +795,7 @@ class SlotScheduler:
             others = any(s.ticket is not None for s in self.slots)
             with self._engine_lock:
                 if n_data:
-                    eng.write_pool_pages(pages[:n_data],
-                                         {"pages.k": pk, "pages.v": pv})
+                    eng.write_pool_pages(pages[:n_data], page_arrays)
                 if not others and not self._queue and "rng_key" in arrays:
                     eng.set_rng(arrays["rng_key"],
                                 int(meta["chunk_counter"]))
@@ -721,10 +810,14 @@ class SlotScheduler:
             t.priority = int(extra.get("priority", 1))
             t.preempt_count = int(extra.get("preempt_count", 0))
             t.parked_ms = float(extra.get("parked_ms", 0.0))
+            t.spill_ms = float(extra.get("spill_ms", 0.0))
             t._on_cancel = self._wake
             s = self.slots[slot_idx]
             s.ticket = t
             s.pages = pages
+            s.budget = n_total
+            s.spilled = False
+            s.active_at = time.monotonic()
             s.prefix_tokens = 0
             # prompt pages become radix-shareable once prefill completes;
             # a decode-phase import never re-inserts (alignment with the
@@ -764,6 +857,11 @@ class SlotScheduler:
             return
         t.finish = reason
         t.error = error
+        if self.pool is not None:
+            # a spilled slot owns no pages; its host-pool record dies
+            # with the request (dropped while the ticket is still bound
+            # so the spilled interval lands on its spill_ms clock)
+            self._drop_spilled_locked(slot_idx)
         s.ticket = None
         # flush point for speculation: pending drafts die with the slot
         # and the proposer forgets its per-slot state (a later occupant
@@ -795,6 +893,8 @@ class SlotScheduler:
                           preempt_count=t.preempt_count or None,
                           parked_ms=round(t.parked_ms, 3)
                           if t.parked_ms else None,
+                          spill_ms=round(t.spill_ms, 3)
+                          if t.spill_ms else None,
                           spec_proposed=t.spec_proposed or None,
                           spec_accepted=t.spec_accepted
                           if t.spec_proposed else None)
@@ -810,11 +910,16 @@ class SlotScheduler:
 
     def _bind_pages(self, slot_idx: int, t: Ticket) -> bool:
         """Paged admission: match the prompt against the radix tree, then
-        reserve every page the request can ever touch (matched prefix +
-        fresh pages through ``min(len(prompt) + max_new, seq_len)``).
-        Full reservation up front is what keeps exhaustion out of the
-        dispatch path — a request that cannot get its pages stays queued
-        (False), it never fails mid-decode.  Caller holds the lock."""
+        reserve pages.  Under ``full`` reservation that is every page the
+        request can ever touch (matched prefix + fresh pages through
+        ``min(len(prompt) + max_new, seq_len)``) — exhaustion stays out
+        of the dispatch path because a request that cannot get its pages
+        stays queued (False), it never fails mid-decode.  Under
+        ``optimistic`` only ``ceil((prompt + spill_headroom)/page)`` is
+        bound here; the slot grows page-by-page between dispatch rounds
+        (:meth:`_tier_round_locked`'s ladder: alloc → radix evict →
+        spill → park), so over-commit degrades to queueing either way.
+        Caller holds the lock."""
         pool = self.pool
         ps = pool.page_size
         prompt = t.prompt
@@ -833,7 +938,11 @@ class SlotScheduler:
         # this admission just matched
         pool.incref(shared)
         need_len = min(len(prompt) + t.max_new, self.engine.seq_len)
-        fresh = -(-need_len // ps) - len(shared)
+        if self.optimistic:
+            reserve_len = min(len(prompt) + self.spill_headroom, need_len)
+        else:
+            reserve_len = need_len
+        fresh = -(-reserve_len // ps) - len(shared)
         try:
             new_pages = pool.alloc(fresh)
         except PagePoolExhausted:
@@ -860,6 +969,11 @@ class SlotScheduler:
         s.pages = list(shared) + new_pages
         s.prefix_tokens = matched
         s.inserted = False
+        # full-reservation page count: the growth ceiling under
+        # optimistic mode (and trivially == len(s.pages) under full)
+        s.budget = -(-need_len // ps)
+        s.spilled = False
+        s.active_at = time.monotonic()
         # the slot's page-table row: reserved pages first, scratch page 0
         # everywhere else (unreserved entries absorb overshoot writes)
         row = self._page_tables[slot_idx]
@@ -1036,6 +1150,10 @@ class SlotScheduler:
                            extra={"rid": t.rid, "error": repr(e)})
         t.preempt_count += 1
         self._parked.append(_Parked(t, blob, path, now))
+        # a spilled victim parks from its host-pool copy (the export
+        # above read it); the record is now redundant with the DLREQ01
+        # blob — drop it while the ticket is still bound
+        self._drop_spilled_locked(slot_idx)
         s.ticket = None
         t.slot = None
         if s.pages:
@@ -1087,16 +1205,16 @@ class SlotScheduler:
         n_data = -(-pos // ps)
         need = min(len(t.prompt) + t.max_new, eng.seq_len)
         n_total = -(-need // ps)
-        try:
-            pages = self.pool.alloc(n_total)
-        except PagePoolExhausted:
-            pages = None
-            if self.prefix_cache is not None:
-                self.prefix_cache.evict(n_total - self.pool.available)
-                try:
-                    pages = self.pool.alloc(n_total)
-                except PagePoolExhausted:
-                    pass
+        if self.optimistic:
+            # resume with the written pages plus headroom (same shape as
+            # optimistic admission); growth resumes page-by-page
+            n_alloc = max(n_data,
+                          -(-min(pos + self.spill_headroom, need) // ps))
+        else:
+            n_alloc = n_total
+        # the full ladder applies: resuming a parked request may spill
+        # an idle neighbor to make room (round boundary — safe)
+        pages = self._alloc_ladder_locked(n_alloc)
         if pages is None:
             return False
         extra = dict(meta.get("extra", {}))
@@ -1113,6 +1231,9 @@ class SlotScheduler:
         s.pages = pages
         s.prefix_tokens = 0
         s.inserted = int(extra.get("fed", 0)) >= len(t.prompt)
+        s.budget = n_total
+        s.spilled = False
+        s.active_at = now
         s.pos = pos
         s.fed = int(extra.get("fed", 0))
         s.produced = int(extra.get("produced", len(t.emitted)))
@@ -1159,6 +1280,204 @@ class SlotScheduler:
                 self._drop_parked_locked(e)
                 self._fail_ticket(t, "timeout")
 
+    # -- KV tiering (optimistic growth → spill → page-in) --------------
+    def _spill_key(self, slot_idx: int):
+        """Host-pool key for one slot's spill record: the (slot, rid)
+        pair, so a slot re-bound to a new ticket can never collide with
+        a stale record of its previous occupant."""
+        return (slot_idx, self.slots[slot_idx].ticket.rid)
+
+    def _drop_spilled_locked(self, slot_idx: int) -> None:
+        """Forget a slot's spill record (retire / park / page-in), and
+        charge the spilled interval to the ticket's ``spill_ms`` clock.
+        Idempotent — a no-op for slots with no record."""
+        rec = self._spilled.pop(slot_idx, None)
+        if rec is None:
+            return
+        s = self.slots[slot_idx]
+        if s.ticket is not None:
+            s.ticket.spill_ms += (time.monotonic() - rec["since"]) * 1e3
+        if self.host_pool is not None:
+            self.host_pool.drop(rec["key"])
+        s.spilled = False
+
+    def _spill_slot_locked(self, slot_idx: int) -> bool:
+        """Move one slot's resident pages to the host pool (caller holds
+        ``self._cond``; zero dispatches in flight — the round-boundary
+        invariant _dispatch provides).  The page payload is read through
+        the engine's async D2H path, stored whole in the host pool, and
+        only THEN are the device pages released — a refused or failed
+        spill leaves the slot fully resident, so the ladder can fall
+        back to preemption without replaying anything."""
+        from . import kvtier
+
+        s = self.slots[slot_idx]
+        t = s.ticket
+        n = len(s.pages)
+        if (self.host_pool is None or not n
+                or not self.host_pool.would_fit(n * self._page_nbytes)):
+            return False
+        FAULTS.fire("kv.spill")
+        with self._engine_lock:
+            handles = self.engine.read_pool_pages_async(s.pages)
+        arrays = {k: h.wait() for k, h in handles.items()}
+        key = self._spill_key(slot_idx)
+        if not self.host_pool.put(key, arrays, {"pos": s.pos}):
+            return False
+        self.pool.decref(s.pages)
+        s.pages = []
+        s.spilled = True
+        self._page_tables[slot_idx][:] = 0
+        now = time.monotonic()
+        self._spilled[slot_idx] = {"key": key, "since": now, "n_pages": n}
+        obs_metrics.KV_PAGES_SPILLED.inc(n)
+        obs_metrics.KV_SPILL_BYTES.inc(kvtier.arrays_nbytes(arrays))
+        obs_metrics.KV_PAGES_IN_USE.set(self.pool.in_use)
+        ctx = request_id_var.set(t.rid)
+        try:
+            _log.info("kv spill", extra={"slot": slot_idx, "pages": n,
+                                         "pos": s.pos})
+        finally:
+            request_id_var.reset(ctx)
+        obs_flight.phase(t.rid, "kv_spill", slot=slot_idx, pages=n)
+        return True
+
+    def _spill_one_locked(self, exclude: int | None = None) -> bool:
+        """Pick the best spill victim (idle-longest, index tie-break —
+        kvtier.rank_victims) among active resident slots and spill it.
+        ``exclude`` protects the slot the ladder is growing — spilling
+        the grower to feed the grower would livelock."""
+        from . import kvtier
+
+        cands = [(i, self.slots[i].active_at) for i in self._active()
+                 if i != exclude and not self.slots[i].spilled
+                 and self.slots[i].pages]
+        for idx in kvtier.rank_victims(cands):
+            if self._spill_slot_locked(idx):
+                return True
+        return False
+
+    def _alloc_ladder_locked(self, n: int, exclude: int | None = None,
+                             allow_spill: bool = True):
+        """Allocate ``n`` pages, escalating through the reclaim ladder:
+        free list → radix-tree eviction (cold shared prefixes) → host
+        spill of idle slots.  Returns the page list or None — the caller
+        decides the fallback (queue the admission, park the slot).  Each
+        rung only frees pages no slot row references, so recycled pages
+        are safe even under an in-flight pipelined dispatch; the spill
+        rung additionally reads device state and is round-boundary only
+        (callers pass ``allow_spill=False`` mid-flight)."""
+        if n <= 0:
+            return []
+        pool = self.pool
+        try:
+            return pool.alloc(n)
+        except PagePoolExhausted:
+            pass
+        if self.prefix_cache is not None:
+            self.prefix_cache.evict(n - pool.available)
+            try:
+                return pool.alloc(n)
+            except PagePoolExhausted:
+                pass
+        if allow_spill and self.host_pool is not None:
+            while pool.available < n:
+                if not self._spill_one_locked(exclude):
+                    return None
+            try:
+                return pool.alloc(n)
+            except PagePoolExhausted:  # pragma: no cover - defensive
+                return None
+        return None
+
+    def _grow_slot_locked(self, slot_idx: int, target_pos: int,
+                          allow_spill: bool = True) -> bool:
+        """Ensure ``slot_idx`` owns every page the write of token
+        positions ``[0, target_pos)`` touches, growing through the
+        reclaim ladder.  Growth MUST land before the dispatch that
+        writes past the reserved prefix — unreserved page-table entries
+        hold scratch page 0, which absorbs (and silently discards)
+        overshoot writes.  Clamped to the slot's full-reservation budget
+        so optimistic never holds more than full mode would."""
+        s = self.slots[slot_idx]
+        ps = self.pool.page_size
+        need = min(-(-int(target_pos) // ps), s.budget)
+        extra = need - len(s.pages)
+        if extra <= 0:
+            return True
+        pages = self._alloc_ladder_locked(extra, exclude=slot_idx,
+                                          allow_spill=allow_spill)
+        if pages is None:
+            return False
+        s.pages.extend(pages)
+        self._page_tables[slot_idx][:len(s.pages)] = s.pages
+        obs_metrics.KV_PAGES_IN_USE.set(self.pool.in_use)
+        return True
+
+    def _try_page_in_locked(self) -> None:
+        """Bring spilled slots back to residency, oldest spill first
+        (FIFO — the longest-stalled consumer un-stalls first).  Runs
+        before admission so freed pages prefer slots that already hold
+        tickets over fresh admissions.  The ladder runs WITHOUT the
+        spill rung here: paging one slot in by spilling another would
+        ping-pong."""
+        order = sorted(self._spilled.items(),
+                       key=lambda kv: (kv[1]["since"], kv[0]))
+        for slot_idx, rec in order:
+            s = self.slots[slot_idx]
+            pages = self._alloc_ladder_locked(rec["n_pages"],
+                                              allow_spill=False)
+            if pages is None:
+                return
+            got = self.host_pool.pop(rec["key"])
+            if got is None:  # pragma: no cover - defensive
+                self._spilled.pop(slot_idx, None)
+                s.spilled = False
+                self.pool.decref(pages)
+                continue
+            arrays, _meta = got
+            with self._engine_lock:
+                self.engine.write_pool_pages(pages, arrays)
+            s.pages = list(pages)
+            s.spilled = False
+            row = self._page_tables[slot_idx]
+            row[:] = 0
+            row[:len(pages)] = pages
+            t = s.ticket
+            stalled_ms = (time.monotonic() - rec["since"]) * 1e3
+            t.spill_ms += stalled_ms
+            self._spilled.pop(slot_idx, None)
+            obs_metrics.KV_PAGES_PAGED_IN.inc(len(pages))
+            obs_metrics.KV_PAGES_IN_USE.set(self.pool.in_use)
+            ctx = request_id_var.set(t.rid)
+            try:
+                _log.info("kv page-in", extra={
+                    "slot": slot_idx, "pages": len(pages),
+                    "stalled_ms": round(stalled_ms, 3)})
+            finally:
+                request_id_var.reset(ctx)
+            obs_flight.phase(t.rid, "kv_pagein", slot=slot_idx,
+                             pages=len(pages),
+                             stalled_ms=round(stalled_ms, 3))
+
+    def _tier_round_locked(self, now: float) -> None:
+        """Between-rounds tiering pass (caller holds ``self._cond``,
+        zero dispatches in flight): grow every active resident slot to
+        cover the widest write the next dispatch can issue.  A slot the
+        ladder cannot make room for parks (``kv_pressure``) — the same
+        honest-queueing degradation as admission-time exhaustion."""
+        if not self.optimistic:
+            return
+        reach = max(self.prefill_chunk, self.decode_burst,
+                    (self.spec_k + 1) if self.spec is not None else 1)
+        for i in self._active():
+            s = self.slots[i]
+            if s.spilled:
+                continue
+            target = min(s.pos + reach, int(self.engine.seq_len))
+            if not self._grow_slot_locked(i, target, allow_spill=True):
+                self._preempt_locked(i, "kv_pressure", now)
+
     def _active(self) -> list[int]:
         return [i for i, s in enumerate(self.slots) if s.ticket is not None]
 
@@ -1204,16 +1523,28 @@ class SlotScheduler:
                         self._queue.remove(t)
                         self._fail_ticket(t, t._cancel or "timeout")
                     self._sweep_parked_locked(now)
+                    if self.paged and self._spilled:
+                        # spilled slots rejoin before fresh admissions:
+                        # they hold live tickets whose consumers are
+                        # stalled, so freed pages go to them first
+                        self._try_page_in_locked()
                     if not self._paused:
                         self._admit_locked(now)
-                    active = self._active()
+                    if self.paged and self.optimistic:
+                        self._tier_round_locked(now)
+                    real_active = self._active()
+                    # a spilled slot holds a ticket but no pages — it
+                    # must sit out the dispatch (its page-table row is
+                    # all scratch) until _try_page_in_locked restores it
+                    active = [i for i in real_active
+                              if not self.slots[i].spilled]
                     queued = len(self._queue)
                     obs_metrics.SCHED_SLOTS_OCCUPIED.set(len(active))
                     obs_metrics.SCHED_QUEUE_DEPTH.set(queued)
                     if self._stop:
                         return
                     if not active:
-                        if self._paused:
+                        if self._paused and not real_active:
                             self._idle.set()
                         # parked: submissions/cancels/close notify_all
                         # immediately, so the timeout only has to cover
@@ -1477,6 +1808,17 @@ class SlotScheduler:
             # overlap on/off A/B compares dispatch pipelining alone
             steps2 = max(1, min(self.decode_burst, room))
             steps2 = 1 << (steps2.bit_length() - 1)
+            if self.paged and self.optimistic:
+                # pipelined chains are unbounded per round (cur = nxt
+                # loops), so the round-start grow cannot cover them:
+                # each burst grows its rows here.  No spill rung — a
+                # D2H page read would order behind the in-flight
+                # dispatch; radix eviction stays safe mid-flight (it
+                # only frees pages no slot row references)
+                for j in cur.active:
+                    if not self._grow_slot_locked(
+                            j, int(pos2[j]) + steps2, allow_spill=False):
+                        return None
             # the import path rewrites _page_tables under _cond; freeze
             # a copy so the enqueue below (outside the lock) cannot
             # observe a half-written row
@@ -1765,6 +2107,11 @@ class SlotScheduler:
         advance the slot clocks.  Caller holds ``self._cond``."""
         eng = self.engine
         slots = self.slots
+        now = time.monotonic()
+        for i in active:
+            # the spill victim clock: a slot that took part in this
+            # dispatch was active now, whatever it emitted
+            slots[i].active_at = now
         for j in range(steps):
             for i in active:
                 s = slots[i]
@@ -1819,8 +2166,10 @@ class SlotScheduler:
         Caller holds ``self._cond``."""
         eng = self.engine
         slots = self.slots
+        now = time.monotonic()
         for i in active:
             s = slots[i]
+            s.active_at = now
             t = s.ticket
             if t is None:  # retired between enqueue and land
                 continue

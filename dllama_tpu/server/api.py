@@ -626,6 +626,11 @@ class ApiState:
             "batch_efficiency":
                 obs_metrics.SCHED_BATCH_EFFICIENCY.json_value(),
             "handoff": self.handoff,
+            # KV tiering (runtime/kvtier.py): the router's free-KV
+            # tiebreak should see effective capacity — resident free
+            # pages plus pages reclaimable by spilling idle slots —
+            # not just the resident free list
+            "kv_pressure": occ.get("kv_pressure") if occ else None,
         }
         return {
             "status": "draining" if self.draining else "ok",
@@ -2586,18 +2591,26 @@ def main(argv=None):
     if args.batch_slots > 0:
         # share the chat engine's placed weights; only a new KV cache is
         # allocated (see ApiState docstring)
+        kv_quant = getattr(args, "kv_quant", "off") == "int8"
         if args.kv_pages > 0 and engine.cache.quantized:
-            raise SystemExit("--kv-pages needs a dense KV cache; drop "
-                             "--kv-cache-dtype q8")
+            raise SystemExit("--kv-pages needs a dense chat-engine KV "
+                             "cache; drop --kv-cache-dtype q8 (use "
+                             "--kv-quant int8 to quantize the paged pool)")
+        if kv_quant and args.kv_pages <= 0:
+            raise SystemExit("--kv-quant int8 needs a paged pool "
+                             "(--kv-pages); contiguous slot rows have no "
+                             "per-page scales")
         batch_engine = Engine(engine.cfg, engine.params, mesh=engine.mesh,
                               batch=args.batch_slots, seq_len=args.max_seq_len,
-                              kv_dtype=engine.cache.k.dtype,
+                              kv_dtype="q8" if kv_quant
+                              else engine.cache.k.dtype,
                               step_timeout=args.step_timeout,
                               kv_pages=args.kv_pages,
                               kv_page_size=args.kv_page_size)
         _log.info("batch_serving_enabled",
                   extra={"slots": args.batch_slots,
-                         "kv_pages": args.kv_pages})
+                         "kv_pages": args.kv_pages,
+                         "kv_quant": "int8" if kv_quant else "off"})
         try:
             # tentpole: continuous batching — single-stream requests join
             # the batch engine at decode-step granularity instead of
@@ -2620,7 +2633,10 @@ def main(argv=None):
                 preempt_age_ms=args.preempt_age_ms,
                 preempt_cap=args.preempt_cap,
                 spill_dir=args.preempt_spill_dir,
-                spec=spec, spec_k=args.spec_k)
+                spec=spec, spec_k=args.spec_k,
+                kv_reserve=getattr(args, "kv_reserve", "full"),
+                spill_headroom=getattr(args, "spill_headroom", 16),
+                host_pool_mb=getattr(args, "kv_host_pool_mb", 64.0))
             _log.info("slot_scheduler_enabled", extra={
                 "slots": args.batch_slots,
                 "prefill_chunk": args.sched_prefill_chunk,
@@ -2629,6 +2645,8 @@ def main(argv=None):
                 "prefix_reuse": scheduler.prefix_cache is not None,
                 "overlap": scheduler.overlap,
                 "preempt": scheduler.preempt and scheduler.paged,
+                "kv_reserve": scheduler.kv_reserve,
+                "kv_quant": "int8" if kv_quant else "off",
                 "spec": args.spec, "spec_k": args.spec_k})
         except ValueError as e:
             # quantized KV / sp mesh: lockstep batch serving still works,

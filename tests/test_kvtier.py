@@ -1,0 +1,314 @@
+"""KV memory-tiering tests: optimistic reservation, host spill, int8 pages.
+
+The tiering contracts (docs/PERF.md "KV memory tiering"), each pinned
+here on CPU with the tiny model:
+
+* **host pool semantics** — the pinned host-RAM page pool stores and
+  returns spilled page payloads byte-exact, refuses duplicate keys and
+  over-capacity puts (a refused spill leaves the victim resident — the
+  scheduler depends on that), and a zero-capacity pool is disabled;
+* **victim ranking** — idle-longest slots spill first, slot index as
+  the deterministic tiebreak;
+* **spill / page-in byte parity** — an optimistic scheduler on a pool
+  far smaller than the workload's full-reservation demand serves every
+  request byte-identical to its uncontended solo run, with the
+  overlapped dispatch pipeline both on and off, and ends with the host
+  pool empty and every page back on the free list;
+* **int8 KV pages** — the per-page-scale quantization round-trips
+  within its absmax/127 step; a ``--kv-quant int8`` scheduler's greedy
+  decode tracks the dense oracle and the dispatch ledger carries the
+  ``kv_int8`` codec label;
+* **snapshot codec** — DLREQ01 hand-off records from an int8 pool
+  import byte-exact into another int8 replica and are cleanly refused
+  by a dense one (the codec is part of the hand-off fingerprint);
+* **exhaustion fallback** — with the host pool disabled, page pressure
+  degrades to preempt/park (honest queueing), never to wrong bytes.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from dllama_tpu.models.config import tiny_config
+from dllama_tpu.models.params import init_params
+from dllama_tpu.obs import dispatch as obs_dispatch
+from dllama_tpu.obs import metrics as obs_metrics
+from dllama_tpu.parallel.mesh import make_mesh
+from dllama_tpu.runtime import kvtier
+from dllama_tpu.runtime import snapshot as snapfmt
+from dllama_tpu.runtime.engine import Engine
+from dllama_tpu.runtime.faults import FAULTS, injected
+from dllama_tpu.runtime.kvtier import HostPagePool, rank_victims
+from dllama_tpu.runtime.scheduler import SlotScheduler
+
+pytestmark = pytest.mark.kvtier
+
+CFG = tiny_config(seq_len=64)
+PAGE = 4
+P1 = [5, 9, 2]
+P2 = [7, 3, 11, 4, 6, 1, 8]
+P3 = [2, 4, 6]
+PROMPTS = (P1, P2, P3)
+MAX_NEW = 24
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+def make_paged_engine(batch=2, kv_dtype=None, kv_pages=None):
+    pages_per_slot = -(-CFG.seq_len // PAGE)
+    return Engine(CFG, init_params(CFG, seed=4),
+                  mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+                  batch=batch,
+                  kv_pages=kv_pages or batch * pages_per_slot + 1,
+                  kv_page_size=PAGE, kv_dtype=kv_dtype)
+
+
+@pytest.fixture(scope="module")
+def solo_refs():
+    """Greedy solo completions per prompt — the parity oracle."""
+    eng = Engine(CFG, init_params(CFG, seed=4),
+                 mesh=make_mesh(tp=1, devices=jax.devices()[:1]), batch=1)
+    refs = {}
+    for p in PROMPTS:
+        eng.reset()
+        toks = [t for t, _ in eng.generate_stream(
+            p, len(p) + MAX_NEW, temperature=0.0, chunk=5)]
+        refs[tuple(p)] = toks[len(p):]
+    return refs
+
+
+def run_sched(solo_refs, kv_dtype=None, check_parity=True, **kw):
+    """Three greedy requests through a 2-slot scheduler; returns
+    (token lists, final occupancy)."""
+    eng = make_paged_engine(kv_dtype=kv_dtype,
+                            kv_pages=kw.pop("kv_pages", None))
+    sched = SlotScheduler(eng, prefill_chunk=8, decode_burst=4, **kw)
+    try:
+        tickets = [sched.submit(list(p), max_new=MAX_NEW, temperature=0.0)
+                   for p in PROMPTS]
+        outs = [list(t.tokens()) for t in tickets]
+        sched.pool.check()
+        occ = sched.occupancy()
+    finally:
+        sched.close(timeout=60)
+    if check_parity:
+        for p, o in zip(PROMPTS, outs):
+            r = solo_refs[tuple(p)]
+            n = min(len(o), len(r))
+            assert n >= MAX_NEW - 8 and o[:n] == r[:n], \
+                f"scheduler drifted from solo oracle on {p}: {o} vs {r}"
+    return outs, occ
+
+
+# --- unit: host pool + victim ranking -------------------------------------
+
+def test_host_pool_roundtrip_and_refusals():
+    arrays = {"pages.k": np.arange(48, dtype=np.float32).reshape(2, 24),
+              "pages.v": np.ones((2, 24), np.float32)}
+    nbytes = kvtier.arrays_nbytes(arrays)
+    pool = HostPagePool(capacity_bytes=2 * nbytes)
+    assert pool.would_fit(nbytes)
+    assert pool.put(("k1", "r1"), arrays, {"pos": 9})
+    assert ("k1", "r1") in pool and len(pool) == 1
+    assert pool.bytes_used == nbytes
+
+    got, meta = pool.get(("k1", "r1"))
+    assert meta["pos"] == 9
+    for name, a in arrays.items():
+        np.testing.assert_array_equal(got[name], a)
+
+    # duplicate key refused — a double spill of one slot is a bug, and
+    # silently overwriting the first payload would lose bytes
+    assert not pool.put(("k1", "r1"), arrays, {})
+    # over capacity refused: the caller keeps the victim resident
+    assert pool.put(("k2", "r2"), arrays, {})
+    assert not pool.would_fit(nbytes)
+    assert not pool.put(("k3", "r3"), arrays, {})
+    assert len(pool) == 2
+
+    popped, _ = pool.pop(("k1", "r1"))
+    np.testing.assert_array_equal(popped["pages.k"], arrays["pages.k"])
+    assert ("k1", "r1") not in pool
+    assert pool.pop(("k1", "r1")) is None
+    pool.drop(("k2", "r2"))
+    assert pool.bytes_used == 0 and len(pool) == 0
+
+    # capacity <= 0 disables the pool entirely
+    off = HostPagePool(capacity_bytes=0)
+    assert not off.would_fit(1)
+    assert not off.put(("k", "r"), arrays, {})
+
+
+def test_host_pool_bytes_gauge_tracks():
+    arrays = {"x": np.zeros(128, np.int8)}
+    pool = HostPagePool(capacity_bytes=4096)
+    pool.put(("a", "r"), arrays, {})
+    assert obs_metrics.KV_HOST_POOL_BYTES.value >= 128
+    pool.clear()
+    assert pool.bytes_used == 0
+
+
+def test_rank_victims_orders_idle_longest():
+    # (slot_idx, active_at): oldest activity first, index breaks ties
+    cands = [(3, 50.0), (0, 10.0), (2, 10.0), (1, 99.0)]
+    assert rank_victims(cands) == [0, 2, 3, 1]
+    assert rank_victims([]) == []
+
+
+# --- int8 page codec ------------------------------------------------------
+
+def test_int8_quant_roundtrip_tolerance():
+    """quantize_kv/dequant_kv round-trip within the absmax/127 step —
+    per (…, position) scales, so one hot row cannot blunt its neighbors."""
+    from dllama_tpu.ops.attention import dequant_kv, quantize_kv
+    rng = np.random.RandomState(0)
+    x = (rng.randn(2, 2, 8, 16) * np.array([0.1, 10.0])[None, :, None,
+                                            None]).astype(np.float32)
+    vals, scale = quantize_kv(x)
+    assert vals.dtype == np.int8
+    assert scale.shape == x.shape[:3] + (1,)
+    back = np.asarray(dequant_kv(vals, scale), np.float32)
+    step = np.abs(x).max(axis=-1, keepdims=True) / 127.0
+    assert np.all(np.abs(back - x) <= step + 1e-6), \
+        "dequantized KV outside one quantization step"
+
+
+def test_int8_sched_parity_and_ledger(solo_refs):
+    """Greedy decode through an int8 paged pool tracks the dense solo
+    oracle (tolerance: a long shared prefix — quantization noise may
+    legitimately flip a late token) and the dispatch ledger labels the
+    paged reads with the kv_int8 codec."""
+    outs, occ = run_sched(solo_refs, kv_dtype="q8", check_parity=False)
+    for p, o in zip(PROMPTS, outs):
+        r = solo_refs[tuple(p)]
+        agree = 0
+        for a, b in zip(o, r):
+            if a != b:
+                break
+            agree += 1
+        assert agree >= 6, \
+            f"int8 KV diverged from dense oracle too early on {p}: " \
+            f"{o} vs {r}"
+    assert occ["kv_pressure"]["codec"] == "int8", occ["kv_pressure"]
+    led = obs_dispatch.dispatches()
+    assert any("kv_int8" in str(k) for k in led), \
+        f"no kv_int8 ledger entry: {list(led)}"
+
+
+# --- spill / page-in parity -----------------------------------------------
+
+@pytest.mark.parametrize("overlap", [True, False],
+                         ids=["overlap", "no-overlap"])
+def test_optimistic_spill_parity(solo_refs, overlap):
+    """THE tiering acceptance: a 9-usable-page pool against ~22 pages of
+    full-reservation demand — requests seat on prompt-sized bindings,
+    grow page-by-page, spill idle-longest victims to host RAM and page
+    them back in, and every completion is byte-identical to solo."""
+    spilled0 = obs_metrics.KV_PAGES_SPILLED.value
+    paged0 = obs_metrics.KV_PAGES_PAGED_IN.value
+    _, occ = run_sched(solo_refs, kv_reserve="optimistic",
+                       spill_headroom=4, host_pool_mb=8, kv_pages=10,
+                       overlap=overlap)
+    assert obs_metrics.KV_PAGES_SPILLED.value - spilled0 >= 1, \
+        "pool at 40% of demand must engage the spill path"
+    assert obs_metrics.KV_PAGES_PAGED_IN.value - paged0 >= 1
+    kvp = occ["kv_pressure"]
+    assert kvp["reserve"] == "optimistic"
+    assert kvp["host_pool_bytes"] == 0 and kvp["spilled_slots"] == 0, kvp
+    assert occ["kv_pages_free"] == occ["kv_pages_total"], \
+        f"page leak after drain: {occ}"
+
+
+def test_full_reservation_unchanged(solo_refs):
+    """Default mode is full reservation: no spill machinery engages even
+    with a host pool configured, and parity holds."""
+    spilled0 = obs_metrics.KV_PAGES_SPILLED.value
+    _, occ = run_sched(solo_refs, host_pool_mb=8)
+    assert obs_metrics.KV_PAGES_SPILLED.value == spilled0
+    assert occ["kv_pressure"]["reserve"] == "full"
+
+
+def test_exhaustion_falls_back_to_preempt(solo_refs):
+    """Host pool disabled (--kv-host-pool-mb 0): growth on an exhausted
+    pool cannot spill, so the grow ladder preempts the slot instead —
+    over-commit degrades to honest queueing, and the parked request
+    still resumes to a byte-identical finish."""
+    pre0 = sum((obs_metrics.snapshot_json().get("sched_preemptions")
+                or {}).values())
+    _, occ = run_sched(solo_refs, kv_reserve="optimistic",
+                       spill_headroom=4, host_pool_mb=0, kv_pages=10)
+    pre = sum((obs_metrics.snapshot_json().get("sched_preemptions")
+               or {}).values())
+    assert pre > pre0, "pressure without a host pool must preempt"
+    assert occ["kv_pressure"]["host_pool_bytes"] == 0
+    assert occ["kv_pages_free"] == occ["kv_pages_total"], occ
+
+
+# --- snapshot codec -------------------------------------------------------
+
+def test_handoff_codec_roundtrip_int8(solo_refs):
+    """A DLREQ01 record exported mid-decode from an int8 pool imports
+    into another int8 replica and resumes to the same tokens an
+    uninterrupted int8 run produces."""
+    # uninterrupted int8 reference
+    (ref_out, *_), _ = run_sched(solo_refs, kv_dtype="q8",
+                                 check_parity=False)
+
+    sa = SlotScheduler(make_paged_engine(kv_dtype="q8"), prefill_chunk=8,
+                       decode_burst=4)
+    sb = SlotScheduler(make_paged_engine(kv_dtype="q8"), prefill_chunk=8,
+                       decode_burst=4)
+    try:
+        assert sa.engine.handoff_fingerprint() == \
+            sb.engine.handoff_fingerprint()
+        with injected("engine.device_step=delay:0.05"):
+            t = sa.submit(list(P1), MAX_NEW, temperature=0.0)
+            it = t.tokens()
+            for _ in range(4):
+                next(it)
+            records = sa.handoff_export_all()
+        list(it)
+        assert t.finish == "handoff"
+        meta, _ = snapfmt.loads_request(records[t.rid])
+        replayed = [int(x) for x in meta["extra"]["completion"]]
+        t2, _ = sb.import_request(records[t.rid])
+        resumed = list(t2.tokens())
+        assert t2.finish == "length"
+        assert replayed + resumed == ref_out, \
+            "int8 hand-off resume drifted from the uninterrupted run"
+    finally:
+        sa.close(timeout=60)
+        sb.close(timeout=60)
+
+
+def test_handoff_codec_mismatch_rejects(solo_refs):
+    """An int8-pool record must be refused by a dense-paged importer
+    (and vice versa): the codec is part of the hand-off fingerprint, so
+    the reject is clean — before any state is written."""
+    sa = SlotScheduler(make_paged_engine(kv_dtype="q8"), prefill_chunk=8,
+                       decode_burst=4)
+    sb = SlotScheduler(make_paged_engine(), prefill_chunk=8,
+                       decode_burst=4)
+    try:
+        assert sa.engine.handoff_fingerprint() != \
+            sb.engine.handoff_fingerprint(), \
+            "codec must be part of replica hand-off identity"
+        with injected("engine.device_step=delay:0.05"):
+            t = sa.submit(list(P1), MAX_NEW, temperature=0.0)
+            it = t.tokens()
+            next(it)
+            records = sa.handoff_export_all()
+        list(it)
+        with pytest.raises(snapfmt.SnapshotMismatch, match="geometry"):
+            sb.import_request(records[t.rid])
+    finally:
+        sa.close(timeout=60)
+        sb.close(timeout=60)

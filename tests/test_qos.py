@@ -317,6 +317,76 @@ def test_preempt_park_resume_byte_parity(solo_refs, overlap):
         sched.close()
 
 
+def test_preempt_spilled_slot_resume_byte_parity(solo_refs):
+    """Preemption meets KV tiering: on an optimistic over-committed pool
+    a batch slot may be SPILLED (pages in host RAM, not resident) when
+    the interactive burst preempts it.  The park exporter must read the
+    victim's KV from the host pool, drop its spill record, and the
+    resumed request must still finish byte-identical — with the host
+    pool drained and zero pages leaked at the end."""
+    pages_per_slot = -(-CFG.seq_len // PAGE)
+    eng = Engine(CFG, init_params(CFG, seed=4),
+                 mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+                 batch=2, kv_pages=11, kv_page_size=PAGE)
+    assert 11 - 1 < 2 * pages_per_slot, "pool must be over-committed"
+    sched = SlotScheduler(eng, prefill_chunk=4, decode_burst=4,
+                          preempt=True, preempt_age_ms=0.0,
+                          prefix_reuse=False, kv_reserve="optimistic",
+                          spill_headroom=4, host_pool_mb=8)
+    spilled0 = obs_metrics.KV_PAGES_SPILLED.value
+    try:
+        done: dict = {}
+
+        def run(key, prompt, n, prio):
+            t = sched.submit(prompt, n, priority=prio)
+            done[key] = (list(t.tokens()), t.finish)
+
+        FAULTS.install("engine.device_step=delay:0.05x1000")
+        b1 = threading.Thread(target=run, args=(
+            "b1", P1, 30, PRIORITY_LEVELS["batch"]))
+        b2 = threading.Thread(target=run, args=(
+            "b2", P2, 30, PRIORITY_LEVELS["batch"]))
+        b1.start()
+        b2.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if sched.occupancy()["active"] == 2:
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError("batch never saturated the slots")
+        # let both grow past their bindings: the 10-usable-page pool
+        # cannot hold 2 × ~9-page demand, so spill traffic is running
+        # when the interactive burst lands
+        time.sleep(0.5)
+        it = threading.Thread(target=run, args=(
+            "it", P3, 6, PRIORITY_LEVELS["interactive"]))
+        it.start()
+        it.join(120)
+        FAULTS.clear()
+        b1.join(240)
+        b2.join(240)
+
+        assert obs_metrics.KV_PAGES_SPILLED.value - spilled0 >= 1, \
+            "over-committed pool never spilled"
+        assert done["it"][0] == solo_refs[tuple(P3)][:6]
+        for k, p in (("b1", P1), ("b2", P2)):
+            toks, finish = done[k]
+            assert finish == "length", (k, finish)
+            assert toks == solo_refs[tuple(p)][:30], \
+                f"{k} drifted through spill/park/resume"
+        occ = sched.occupancy()
+        assert occ["active"] == 0 and occ["parked"] == 0, occ
+        assert occ["kv_pages_free"] == occ["kv_pages_total"], \
+            f"page leak: {occ}"
+        assert occ["kv_pressure"]["host_pool_bytes"] == 0, occ
+        assert occ["kv_pressure"]["spilled_slots"] == 0, occ
+        sched.pool.check()
+    finally:
+        FAULTS.clear()
+        sched.close()
+
+
 def test_preempt_cap_retires_with_honest_finish():
     """preempt_cap=0: the victim cannot be parked, so preemption retires
     it with finish_reason="preempted" and its partial output intact."""

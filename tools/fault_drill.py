@@ -446,6 +446,135 @@ def drill_page_exhaustion(model, tok):
         s.stop()
 
 
+def drill_page_pressure(model, tok):
+    """KV tiering under over-commit: a pool sized at ~40% of the
+    workload's full-reservation demand.  Under --kv-reserve full a page
+    hog starves small requests — they sit queued against a FREE slot
+    until the queue bound refuses the next one (429).  Under optimistic
+    the same pool seats them immediately (pages reclaimed by spilling
+    the hog to host RAM and paging it back in), zero 429s, and every
+    completion stays byte-identical to its uncontended solo run."""
+    # page 4, 2 slots, 15 usable pages (--kv-pages 16).  The hog ("hello"
+    # = 2 tokens under the tiny tokenizer) fully reserves ceil((2 + 50)/4)
+    # = 13 pages; each small (2-3 tokens + 12 new) needs 4.  Under full,
+    # a small can never bind beside the hog (free = 2 < 4); under
+    # optimistic it binds ceil((2 + headroom 4)/4) = 2 pages and grows,
+    # spilling the hog.  --no-prefix-reuse keeps the page audit exact.
+    hog = {"prompt": "hello", "max_tokens": 50}
+    smalls = [{"prompt": p, "max_tokens": 12}
+              for p in ("hi", "hello hi", "hi hello")]
+    flags = ["--batch-slots", "2", "--kv-pages", "16",
+             "--kv-page-size", "4", "--sched-max-queue", "1",
+             "--no-prefix-reuse"]
+
+    def runner(base, results, errors, key, body):
+        def one():
+            try:
+                with post_to(base, "/v1/completions", body) as r:
+                    results[key] = json.loads(r.read())["choices"][0]
+            except urllib.error.HTTPError as e:
+                errors[key] = e.code
+        t = threading.Thread(target=one)
+        t.start()
+        return t
+
+    def wait_occ(base, pred, what, timeout=120):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            occ = get(base, "/health")["scheduler"]
+            if pred(occ):
+                return occ
+            time.sleep(0.05)
+        raise AssertionError(f"timed out waiting for {what}: {occ}")
+
+    # -- phase 1: full reservation starves smalls behind the hog -------
+    s = Server(model, tok, faults="engine.device_step=delay:0.3",
+               extra_flags=flags)
+    try:
+        s.wait_ready()
+        results: dict = {}
+        errors: dict = {}
+        ts = [runner(s.base, results, errors, "hog", hog)]
+        wait_occ(s.base, lambda o: o["active"] >= 1, "hog active")
+        # small1: a slot is FREE, but the hog holds 13 of 15 pages —
+        # full reservation cannot bind 6, so it queues (and its queued
+        # presence clamps the hog's decode bursts: the hog crawls)
+        ts.append(runner(s.base, results, errors, "s0", smalls[0]))
+        wait_occ(s.base, lambda o: o["queued"] >= 1 and o["active"] == 1,
+                 "small starved against a free slot")
+        # the queue is visible at submit; the exhausted counter only
+        # ticks when the scheduler next ATTEMPTS the bind — poll for it
+        deadline = time.monotonic() + 60
+        while get(s.base, "/metrics").get("kv_pool_exhausted", 0) < 1:
+            assert time.monotonic() < deadline, \
+                "kv_pool_exhausted never incremented for the starved small"
+            time.sleep(0.1)
+        ts.append(runner(s.base, results, errors, "s1", smalls[1]))
+        wait_occ(s.base, lambda o: o["queued"] >= 2, "second small queued")
+        # queue now at max-queue + free = 2: the next submission is
+        # refused — full reservation turned a memory shortfall into 429s
+        try:
+            post_to(s.base, "/v1/completions", dict(smalls[2]))
+            raise AssertionError("expected 429 past the queue bound")
+        except urllib.error.HTTPError as e:
+            assert e.code == 429, e.code
+        for t in ts:
+            t.join(300)
+        assert not errors, f"admitted requests must finish: {errors}"
+    finally:
+        s.stop()
+    # -- phase 2: optimistic + spill serves the same load, zero 429s ---
+    s = Server(model, tok, faults="engine.device_step=delay:0.3",
+               extra_flags=flags + ["--kv-reserve", "optimistic",
+                                    "--spill-headroom", "4",
+                                    "--kv-host-pool-mb", "8"])
+    try:
+        s.wait_ready()
+        kvp = get(s.base, "/health")["capacity"]["kv_pressure"]
+        assert kvp["reserve"] == "optimistic", kvp
+        total = get(s.base, "/health")["scheduler"]["kv_pages_total"]
+        # solo greedy references (zero contention): the tiering path
+        # must reproduce these byte-for-byte
+        refs = {}
+        for key, body in [("hog", hog)] + list(zip(
+                ("s0", "s1", "s2"), smalls)):
+            with post_to(s.base, "/v1/completions", body) as r:
+                refs[key] = json.loads(r.read())["choices"][0]["text"]
+        results, errors = {}, {}
+        ts = [runner(s.base, results, errors, "hog", hog)]
+        wait_occ(s.base, lambda o: o["active"] >= 1, "hog active")
+        ts.append(runner(s.base, results, errors, "s0", smalls[0]))
+        # THE tiering proof: the small gets a SLOT (impossible under
+        # full — phase 1 left it queued against the same pool)
+        wait_occ(s.base, lambda o: o["active"] >= 2 or o["queued"] == 0,
+                 "small seated beside the hog")
+        ts.append(runner(s.base, results, errors, "s1", smalls[1]))
+        wait_occ(s.base, lambda o: o["queued"] == 0,
+                 "queue drained before third small")
+        ts.append(runner(s.base, results, errors, "s2", smalls[2]))
+        for t in ts:
+            t.join(300)
+        assert not errors, f"optimistic must not refuse: {errors}"
+        assert len(results) == 4, f"only {len(results)}/4 served"
+        for key, c in sorted(results.items()):
+            assert c["finish_reason"] in ("stop", "length"), c
+            assert c["text"] == refs[key], \
+                f"tiering drift on {key}:\n {c['text']!r}\n" \
+                f" != {refs[key]!r}"
+        m = get(s.base, "/metrics")
+        assert m.get("kv_pages_spilled", 0) >= 1, \
+            f"spill never engaged: {m.get('kv_pages_spilled')}"
+        assert m.get("kv_pages_paged_in", 0) >= 1, m
+        # drained: every page back on the free list, host pool empty
+        occ = get(s.base, "/health")["scheduler"]
+        assert occ["active"] == 0 and occ["queued"] == 0, occ
+        assert occ["kv_pages_free"] == total, f"page leak: {occ}"
+        assert occ["kv_pressure"]["host_pool_bytes"] == 0, occ
+        assert occ["kv_pressure"]["spilled_slots"] == 0, occ
+    finally:
+        s.stop()
+
+
 def drill_priority_preempt(model, tok):
     """Saturate every slot with batch-class decodes, then land an
     interactive burst: the scheduler must admit it by preempting a batch
@@ -1024,6 +1153,7 @@ DRILLS = {
     "latency_histogram": drill_latency_histogram,
     "slot_churn": drill_slot_churn,
     "page_exhaustion": drill_page_exhaustion,
+    "page_pressure": drill_page_pressure,
     "priority_preempt": drill_priority_preempt,
     "slo_burn": drill_slo_burn,
     "overlap_stall": drill_overlap_stall,
