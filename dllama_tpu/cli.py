@@ -383,6 +383,52 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--respawn-window", type=float, default=30.0,
                    help="serve-pod --supervise: sliding window (seconds) "
                         "for the crash-loop counter")
+    # ---- elastic pod (router/elastic.py; docs/SERVING.md) ----
+    p.add_argument("--elastic", action="store_true",
+                   help="serve-pod --supervise: load-driven autoscaling "
+                        "and live tp reshape — a control loop samples "
+                        "fleet /health signals and spawns, drains, or "
+                        "reshapes replicas within the --pod-devices "
+                        "budget.  Needs --handoff + --batch-slots/"
+                        "--kv-pages (in-flight requests migrate over "
+                        "the hand-off wire)")
+    p.add_argument("--pod-devices", type=int, default=0,
+                   help="serve-pod --elastic: total device budget the "
+                        "pod may partition into replicas (default "
+                        "dp × tp — no headroom to grow)")
+    p.add_argument("--min-replicas", type=int, default=1,
+                   help="serve-pod --elastic: scale-down floor")
+    p.add_argument("--max-replicas", type=int, default=0,
+                   help="serve-pod --elastic: scale-up ceiling "
+                        "(default: the boot dp)")
+    p.add_argument("--elastic-interval", type=float, default=2.0,
+                   help="serve-pod --elastic: seconds between control-"
+                        "loop ticks (one fleet sample per tick)")
+    p.add_argument("--elastic-window", type=int, default=5,
+                   help="serve-pod --elastic: samples in the sliding "
+                        "window; EVERY sample must agree before a "
+                        "policy action fires (sustained signal, not a "
+                        "spike)")
+    p.add_argument("--elastic-cooldown", type=float, default=30.0,
+                   help="serve-pod --elastic: seconds after any "
+                        "topology action before the policy may act "
+                        "again (the window also refills from empty)")
+    p.add_argument("--scale-up-util", type=float, default=0.85,
+                   help="serve-pod --elastic: sustained fleet slot "
+                        "utilization at or above this adds a replica")
+    p.add_argument("--scale-down-util", type=float, default=0.15,
+                   help="serve-pod --elastic: sustained utilization at "
+                        "or below this (with an empty queue) retires "
+                        "the most-idle replica")
+    p.add_argument("--scale-up-queue", type=float, default=2.0,
+                   help="serve-pod --elastic: sustained queued requests "
+                        "per replica at or above this also triggers "
+                        "scale-up")
+    p.add_argument("--reshape-kv-low", type=float, default=0.08,
+                   help="serve-pod --elastic: sustained effective-free "
+                        "KV fraction at or below this reshapes to "
+                        "fewer, wider replicas (tp×2) — the long-"
+                        "context answer")
     # ---- observability (docs/OBSERVABILITY.md) ----
     p.add_argument("--log-format", choices=["human", "json"], default=None,
                    help="log output format: human-readable lines or JSON "

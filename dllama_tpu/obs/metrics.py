@@ -801,3 +801,16 @@ POD_REPLICAS_UP = REGISTRY.gauge(
     "pod_replicas_up",
     "serve-pod supervised replica processes currently alive (a "
     "quarantined crash-looper stays down and is not counted).")
+POD_REPLICAS_DESIRED = REGISTRY.gauge(
+    "pod_replicas_desired",
+    "Elastic pod replica target: what the control loop is converging "
+    "toward (desired > up means a scale-up or reshape is in flight).")
+POD_SCALE_EVENTS = REGISTRY.labeled_counter(
+    "pod_scale_events", ("direction", "reason"),
+    "Elastic pod topology actions by direction (up / down / reshape) "
+    "and reason (load, idle, kv_pressure, manual, quarantined).")
+POD_RESHAPE_SECONDS = REGISTRY.histogram(
+    "pod_reshape_seconds", (1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0),
+    "Wall time of one live tp reshape, first spawn/retire to "
+    "convergence — every in-flight request migrated, all replicas on "
+    "the new shape.")

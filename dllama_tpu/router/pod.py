@@ -95,14 +95,29 @@ def partition_devices(devices, dp: int, tp: int) -> list[list]:
 
 # -- supervised (crash-isolated) pod ------------------------------------
 
-def _free_port() -> int:
-    """A fixed port the OS just proved free: respawns rebind the SAME
-    address (ApiServer sets allow_reuse_address), so the registry's
-    hysteretic re-admission recovers the replacement with no
+def _hold_port() -> tuple[int, socket.socket]:
+    """A fixed port the OS just proved free — with the bound socket
+    STILL HELD, closing the pick-then-bind race: nothing else on the
+    host can claim the port between allocation and the child's bind.
+    :meth:`Supervisor.spawn` closes the held socket immediately before
+    ``Popen`` (SO_REUSEADDR on both sides, so the child rebinds the
+    address with no TIME_WAIT stall).  The residual window while the
+    child loads its model is covered by the quarantine ladder: a stolen
+    port makes the child's bind fail, which is a death, which feeds
+    ``--respawn-max``.  Respawns rebind the SAME address, so the
+    registry's hysteretic re-admission recovers the replacement with no
     reconfiguration."""
     s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
+    return s.getsockname()[1], s
+
+
+def _free_port() -> int:
+    """Back-compat shim over :func:`_hold_port` for callers that only
+    want the number (tests); the race-free path is holding the
+    socket."""
+    port, s = _hold_port()
     s.close()
     return port
 
@@ -139,12 +154,13 @@ def _replica_argv(args, port: int, snapdir: str | None) -> list[str]:
             "--temperature", str(args.temperature),
             "--topp", str(args.topp),
             "--chunk", str(args.chunk),
-            "--max-seq-len", str(args.max_seq_len),
             "--max-pending", str(args.max_pending),
             "--request-timeout", str(args.request_timeout),
             "--io-timeout", str(args.io_timeout),
             "--drain-grace", str(args.drain_grace),
             "--buffer-float-type", args.buffer_float_type]
+    if getattr(args, "max_seq_len", None) is not None:
+        argv += ["--max-seq-len", str(args.max_seq_len)]
     if args.batch_slots > 0:
         argv += ["--batch-slots", str(args.batch_slots),
                  "--kv-pages", str(args.kv_pages),
@@ -169,14 +185,20 @@ def _replica_argv(args, port: int, snapdir: str | None) -> list[str]:
 class _Replica:
     """One supervised child: its spawn recipe plus crash-loop history."""
 
-    def __init__(self, idx: int, port: int, argv: list[str], env: dict):
+    def __init__(self, idx: int, port: int, argv: list[str], env: dict,
+                 *, tp: int = 1, ordinals: list[int] | None = None,
+                 sock: socket.socket | None = None):
         self.idx = idx
         self.port = port
         self.argv = argv
         self.env = env
+        self.tp = tp                      # mesh shape (elastic reshape)
+        self.ordinals = ordinals if ordinals is not None else []
+        self.sock = sock                  # held bound port (race fence)
         self.proc: subprocess.Popen | None = None
         self.deaths: collections.deque = collections.deque()
         self.quarantined = False
+        self.retiring = False    # elastic drain in progress: no respawn
         self.ready = False       # answered /health since last spawn
         self.hang_streak = 0
 
@@ -212,18 +234,49 @@ class Supervisor:
         self.hang_probes = max(1, int(hang_probes))
         self.poll_interval = float(poll_interval)
         self.probe_timeout = float(probe_timeout)
+        self._lock = threading.Lock()     # replicas-list mutation
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     def spawn(self, rep: _Replica) -> None:
+        if rep.sock is not None:
+            # held-port fence ends here: release the bound socket in the
+            # instant before the child binds the same address
+            try:
+                rep.sock.close()
+            except OSError:
+                pass
+            rep.sock = None
         rep.proc = subprocess.Popen(rep.argv, env=rep.env)
         rep.ready = False
         rep.hang_streak = 0
         _log.info("pod_replica_spawned", extra={
             "replica": rep.idx, "port": rep.port, "pid": rep.proc.pid})
 
+    # -- runtime membership (elastic pod) -------------------------------
+    def add(self, rep: _Replica) -> None:
+        """Spawn and adopt a replica mid-flight (elastic scale-up)."""
+        self.spawn(rep)
+        with self._lock:
+            self.replicas.append(rep)
+        obs_metrics.POD_REPLICAS_UP.set(self.replicas_up())
+
+    def remove(self, rep: _Replica) -> None:
+        """Forget a replica (elastic scale-down; process already
+        reaped by the caller)."""
+        with self._lock:
+            try:
+                self.replicas.remove(rep)
+            except ValueError:
+                return
+        obs_metrics.POD_REPLICAS_UP.set(self.replicas_up())
+
+    def snapshot(self) -> list[_Replica]:
+        with self._lock:
+            return list(self.replicas)
+
     def start(self) -> None:
-        for rep in self.replicas:
+        for rep in self.snapshot():
             self.spawn(rep)
         obs_metrics.POD_REPLICAS_UP.set(len(self.replicas))
         self._thread = threading.Thread(target=self._watch,
@@ -235,11 +288,12 @@ class Supervisor:
         if self._thread is not None:
             self._thread.join(
                 timeout=self.poll_interval + self.probe_timeout + 2.0)
-        for rep in self.replicas:
+        reps = self.snapshot()
+        for rep in reps:
             if rep.proc is not None and rep.proc.poll() is None:
                 rep.proc.terminate()
         deadline = time.monotonic() + 10.0
-        for rep in self.replicas:
+        for rep in reps:
             if rep.proc is None:
                 continue
             try:
@@ -264,8 +318,10 @@ class Supervisor:
 
     def _watch(self) -> None:
         while not self._stop.wait(self.poll_interval):
-            for rep in self.replicas:
-                if rep.quarantined:
+            for rep in self.snapshot():
+                if rep.quarantined or rep.retiring:
+                    # retiring: the elastic controller owns the drain —
+                    # its exit is completion, not a death to respawn
                     continue
                 if rep.proc is None:
                     # a previous respawn attempt itself failed: treat
@@ -292,7 +348,7 @@ class Supervisor:
             obs_metrics.POD_REPLICAS_UP.set(self.replicas_up())
 
     def replicas_up(self) -> int:
-        return sum(1 for rep in self.replicas
+        return sum(1 for rep in self.snapshot()
                    if not rep.quarantined and rep.proc is not None
                    and rep.proc.poll() is None)
 
@@ -324,6 +380,63 @@ class Supervisor:
         obs_metrics.POD_RESPAWNS.inc(str(rep.idx), reason)
 
 
+class _PodOps:
+    """Process mechanics the elastic controller drives.  Lives here so
+    :mod:`.elastic` never touches subprocess/sockets and stays
+    unit-testable with fakes."""
+
+    def __init__(self, sup: Supervisor, args, snapshot_root: str | None):
+        self.sup = sup
+        self.args = args
+        self.snapshot_root = snapshot_root
+        self._next_idx = 1 + max(
+            (r.idx for r in sup.snapshot()), default=-1)
+
+    def spawn(self, tp: int, ordinals: list[int]) -> _Replica:
+        idx, self._next_idx = self._next_idx, self._next_idx + 1
+        port, sock = _hold_port()
+        snapdir = None
+        if self.snapshot_root:
+            snapdir = os.path.join(self.snapshot_root, f"replica{idx}")
+            os.makedirs(snapdir, exist_ok=True)
+        rep = _Replica(
+            idx, port, _replica_argv(self.args, port, snapdir),
+            _child_env(os.environ, tp, ordinals),
+            tp=tp, ordinals=list(ordinals), sock=sock)
+        self.sup.add(rep)
+        return rep
+
+    def retire(self, rep: _Replica, *, grace: float) -> None:
+        """SIGTERM → drain (live slots export DLREQ01, streams finish
+        ``handoff``) → bounded wait → SIGKILL if the grace blows.  The
+        ``retiring`` flag stops the supervisor treating the exit as a
+        death to respawn."""
+        rep.retiring = True
+        proc = rep.proc
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=grace + 10.0)
+            except subprocess.TimeoutExpired:
+                _log.warning("pod_retire_kill", extra={
+                    "replica": rep.idx, "grace_s": grace})
+                proc.kill()
+                try:
+                    proc.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    pass
+        self.sup.remove(rep)
+
+    def live_replicas(self) -> list[_Replica]:
+        return [r for r in self.sup.snapshot() if not r.quarantined]
+
+    def reap_quarantined(self) -> list[_Replica]:
+        out = [r for r in self.sup.snapshot() if r.quarantined]
+        for r in out:
+            self.sup.remove(r)
+        return out
+
+
 def supervise_main(args) -> None:
     """``serve-pod --supervise``: subprocess replicas under a
     :class:`Supervisor`, fleet router in this (jax-free) parent.
@@ -332,10 +445,14 @@ def supervise_main(args) -> None:
     here would hold the very devices the children need.  The cost of
     isolation is dp separate weight loads (children cannot share a
     host-side read); the payoff is that a replica crash takes down ONE
-    process and the supervisor puts it back."""
+    process and the supervisor puts it back.  ``--elastic`` adds the
+    control loop from :mod:`.elastic`: the pod grows, shrinks, and
+    reshapes its replica set under load, within the ``--pod-devices``
+    budget."""
     if not args.model or not args.tokenizer:
         raise SystemExit("--model and --tokenizer are required for "
                          "serve-pod")
+    from .elastic import DevicePool, ElasticController, ElasticPolicy
     from .registry import Registry
     from .service import RouterState
     from .service import serve as router_serve
@@ -344,17 +461,34 @@ def supervise_main(args) -> None:
     # device count is unknowable without initializing jax; an explicit
     # --workers tpu:N names the per-replica degree, default is 1
     tp = parse_pod_tp(args.workers, 0, dp) if args.workers else 1
+    elastic_on = getattr(args, "elastic", False)
+    if elastic_on:
+        if not getattr(args, "handoff", False):
+            raise SystemExit("serve-pod: --elastic needs --handoff "
+                             "(scale-down migrates in-flight requests "
+                             "over the hand-off wire)")
+        if args.batch_slots <= 0 or args.kv_pages <= 0:
+            raise SystemExit("serve-pod: --elastic needs --batch-slots "
+                             "and --kv-pages (fleet signals come from "
+                             "slot-scheduler occupancy)")
+    pool_size = getattr(args, "pod_devices", 0) or dp * tp
+    if pool_size < dp * tp:
+        raise SystemExit(f"serve-pod: --pod-devices {pool_size} cannot "
+                         f"seat the boot shape dp={dp} × tp={tp}")
+    pool = DevicePool(pool_size)
+    snapshot_root = getattr(args, "snapshot_dir", None)
     replicas = []
     for r in range(dp):
-        port = _free_port()
+        port, sock = _hold_port()
         snapdir = None
-        if getattr(args, "snapshot_dir", None):
-            snapdir = os.path.join(args.snapshot_dir, f"replica{r}")
+        if snapshot_root:
+            snapdir = os.path.join(snapshot_root, f"replica{r}")
             os.makedirs(snapdir, exist_ok=True)
-        ordinals = list(range(r * tp, (r + 1) * tp))
+        ordinals = pool.allocate(tp)
         replicas.append(_Replica(
             r, port, _replica_argv(args, port, snapdir),
-            _child_env(os.environ, tp, ordinals)))
+            _child_env(os.environ, tp, ordinals),
+            tp=tp, ordinals=ordinals, sock=sock))
 
     sup = Supervisor(
         replicas,
@@ -363,6 +497,7 @@ def supervise_main(args) -> None:
         poll_interval=min(1.0, float(args.probe_interval)),
         probe_timeout=min(float(args.upstream_timeout), 2.0))
     sup.start()
+    controller = None
     try:
         registry = Registry(
             [f"127.0.0.1:{rep.port}" for rep in replicas],
@@ -376,10 +511,33 @@ def supervise_main(args) -> None:
             stall_timeout=getattr(args, "stall_timeout", 0.0),
             checkpoint_interval=getattr(args, "checkpoint_interval", 0.0),
             resume_policy=getattr(args, "resume_policy", "auto"))
+        if elastic_on:
+            policy = ElasticPolicy(
+                window=getattr(args, "elastic_window", 5),
+                cooldown=getattr(args, "elastic_cooldown", 30.0),
+                up_util=getattr(args, "scale_up_util", 0.85),
+                down_util=getattr(args, "scale_down_util", 0.15),
+                up_queue=getattr(args, "scale_up_queue", 2.0),
+                kv_low=getattr(args, "reshape_kv_low", 0.08),
+                min_replicas=getattr(args, "min_replicas", 1),
+                max_replicas=getattr(args, "max_replicas", dp))
+            controller = ElasticController(
+                _PodOps(sup, args, snapshot_root), registry, pool, policy,
+                tp=tp,
+                interval=getattr(args, "elastic_interval", 2.0),
+                drain_grace=float(args.drain_grace))
+            rstate.elastic = controller
+            controller.start()
         print(f"💡 serve-pod: supervising {dp} replica process(es) × "
-              f"tp={tp}; router on :{args.port}")
+              f"tp={tp}"
+              + (f" [elastic {policy.min_replicas}"
+                 f"–{policy.max_replicas} over {pool_size} devices]"
+                 if elastic_on else "")
+              + f"; router on :{args.port}")
         router_serve(rstate, host=args.host, port=args.port)
     finally:
+        if controller is not None:
+            controller.stop()
         sup.stop()
 
 
@@ -387,6 +545,10 @@ def main(args) -> None:
     if getattr(args, "supervise", False):
         supervise_main(args)
         return
+    if getattr(args, "elastic", False):
+        raise SystemExit("serve-pod: --elastic requires --supervise "
+                         "(only process replicas can be spawned, "
+                         "drained, and reshaped at runtime)")
 
     import jax
     import jax.numpy as jnp

@@ -55,6 +55,7 @@ class Backend:
         self.fail_streak = 0
         self.ok_streak = 0
         self.ejected = False
+        self.retiring = False             # admission fence (elastic drain)
         self.last_health: dict | None = None
         self.last_probe_s: float | None = None  # EWMA probe RTT
         self.rtt_floor: float | None = None     # best EWMA ever seen
@@ -76,6 +77,7 @@ class Backend:
         return {
             "addr": self.addr,
             "ejected": self.ejected,
+            "retiring": self.retiring,
             "draining": h.get("status") == "draining",
             "fail_streak": self.fail_streak,
             "ok_streak": self.ok_streak,
@@ -146,7 +148,11 @@ class Registry:
         return True
 
     def probe_all(self) -> None:
-        for b in self.backends:
+        # iterate a lock-held copy: the elastic controller adds and
+        # removes backends at runtime from its own thread
+        with self._lock:
+            backends = list(self.backends)
+        for b in backends:
             self.probe(b)
 
     def _probe_loop(self) -> None:
@@ -165,6 +171,55 @@ class Registry:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=self.probe_timeout + 1.0)
+
+    # -- runtime membership (elastic pod) ------------------------------
+    def add(self, addr: str) -> Backend:
+        """Register a backend at runtime.  The newcomer starts with no
+        health record, so dispatch ignores it until its first healthy
+        probe — the same hysteretic admission an ejected backend earns
+        re-entry through."""
+        b = Backend(addr)
+        with self._lock:
+            if any(x.addr == addr for x in self.backends):
+                raise ValueError(f"backend {addr} already registered")
+            self.backends.append(b)
+        _log.info("backend %s registered at runtime", addr)
+        return b
+
+    def remove(self, addr: str) -> Backend | None:
+        """Drop a backend's row.  In-flight dispatches holding the
+        Backend object finish normally; only future picks stop seeing
+        it."""
+        with self._lock:
+            for i, b in enumerate(self.backends):
+                if b.addr == addr:
+                    del self.backends[i]
+                    _log.info("backend %s removed from registry", addr)
+                    return b
+        return None
+
+    def retire(self, addr: str) -> None:
+        """Admission fence: the backend stops receiving NEW dispatches
+        immediately (before its own /health flips to draining), but is
+        not ejected — it stays a valid hand-off exporter while it
+        drains."""
+        with self._lock:
+            for b in self.backends:
+                if b.addr == addr:
+                    b.retiring = True
+                    return
+
+    def get(self, addr: str) -> Backend | None:
+        with self._lock:
+            for b in self.backends:
+                if b.addr == addr:
+                    return b
+        return None
+
+    def score(self, b: Backend) -> float:
+        """Public idle-ness score (elastic victim selection)."""
+        with self._lock:
+            return self._score(b)
 
     # -- dispatch feedback ---------------------------------------------
     def _fail_locked(self, b: Backend, why: str) -> None:
@@ -249,7 +304,8 @@ class Registry:
     def _eligible_locked(self, exclude, *, handoff: bool) -> list[Backend]:
         out = []
         for b in self.backends:
-            if b in exclude or b.ejected or b.last_health is None:
+            if b in exclude or b.ejected or b.retiring \
+                    or b.last_health is None:
                 continue
             h = b.last_health
             if h.get("status") == "draining":
@@ -271,6 +327,12 @@ class Registry:
             return max(cands,
                        key=lambda b: self._score(b, interactive))
 
+    def eligible_backends(self) -> list[Backend]:
+        """Every backend dispatch would consider right now (elastic
+        signal sampling reads their cached health blocks)."""
+        with self._lock:
+            return self._eligible_locked((), handoff=False)
+
     def handoff_peers(self, exclude=()) -> list[Backend]:
         """Eligible hand-off importers, best-scored first (the record is
         offered to each in turn; a geometry 409 moves to the next)."""
@@ -283,6 +345,6 @@ class Registry:
             rows = [b.summary() for b in self.backends]
         avail = sum(1 for r in rows
                     if not r["ejected"] and not r["draining"]
-                    and r["capacity"] is not None)
+                    and not r["retiring"] and r["capacity"] is not None)
         return {"backends": rows, "available": avail,
                 "total": len(rows)}

@@ -125,6 +125,10 @@ class RouterState:
         # router created (X-Dllama-Hop) with this process
         self.hop = f"router-{uuid.uuid4().hex[:8]}"
         self.started_at = time.time()
+        # elastic pod controller (router/elastic.py), set by serve-pod
+        # --elastic: surfaces the fleet block in /health and accepts
+        # /admin/scale + /admin/reshape commands
+        self.elastic = None
 
     def connect(self, b: Backend) -> http.client.HTTPConnection:
         return http.client.HTTPConnection(b.host, b.port,
@@ -146,7 +150,7 @@ class RouterState:
 
     def health(self) -> dict:
         snap = self.registry.snapshot()
-        return {
+        out = {
             "status": "ok" if snap["available"] else "unavailable",
             "ready": snap["available"] > 0,
             "role": "router",
@@ -155,6 +159,9 @@ class RouterState:
             "uptime_s": round(time.time() - self.started_at, 3),
             **snap,
         }
+        if self.elastic is not None:
+            out["fleet"] = self.elastic.fleet_status()
+        return out
 
 
 class _Ctx:
@@ -307,6 +314,33 @@ def make_handler(state: RouterState):
             else:
                 self._json(404, {"error": f"unknown path {path}"})
 
+        def _admin_elastic(self, path, query):
+            """Elastic pod control surface: ``POST /admin/scale?n=N``
+            and ``POST /admin/reshape?tp=N``.  Commands are accepted
+            (202) and executed asynchronously on the controller
+            thread; convergence is observable through the ``fleet``
+            block in ``/health``."""
+            ctl = state.elastic
+            if ctl is None:
+                self._json(404, {"error": "this router has no elastic "
+                                          "controller (run serve-pod "
+                                          "--supervise --elastic)"})
+                return
+            q = parse_qs(query)
+            try:
+                if path == "/admin/scale":
+                    if "n" not in q:
+                        raise ValueError("scale needs ?n=<replicas>")
+                    out = ctl.request_scale(int(q["n"][0]))
+                else:
+                    if "tp" not in q:
+                        raise ValueError("reshape needs ?tp=<degree>")
+                    out = ctl.request_reshape(int(q["tp"][0]))
+            except ValueError as e:
+                self._json(400, {"error": f"bad elastic command: {e}"})
+                return
+            self._json(202, out)
+
         def _proxy_models(self):
             b = state.registry.pick()
             if b is None:
@@ -330,7 +364,10 @@ def make_handler(state: RouterState):
 
         # -- POST surface ----------------------------------------------
         def do_POST(self):
-            path = self.path.partition("?")[0]
+            path, _, query = self.path.partition("?")
+            if path in ("/admin/scale", "/admin/reshape"):
+                self._admin_elastic(path, query)
+                return
             if path not in ("/v1/completions", "/v1/chat/completions"):
                 self._json(404, {"error": f"unknown path {path}"})
                 return

@@ -462,6 +462,17 @@ class SlotScheduler:
                     if (t.deadline and deadline) else (t.deadline or deadline)
             self._cond.notify_all()
 
+    def drain_with_export(self, deadline: float | None) -> dict[str, bytes]:
+        """Bulk drain entry point: stop admissions, clamp every ticket's
+        deadline, and export every live slot as a DLREQ01 record in one
+        call — the shape a fleet-level drain (SIGTERM, elastic
+        scale-down, live reshape) actually wants, so callers cannot
+        forget one half.  Returns the records keyed by request id;
+        ``{}`` when the scheduler has no paged KV pool (nothing
+        exportable — the drain still runs)."""
+        self.begin_drain(deadline)
+        return self.handoff_export_all()
+
     def close(self, timeout: float = 5.0) -> None:
         """Stop the loop; any still-live tickets retire as ``aborted`` so
         no consumer blocks forever."""

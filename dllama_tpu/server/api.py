@@ -426,21 +426,25 @@ class ApiState:
         if self.scheduler is not None:
             # slot-path requests drain too: no new submissions, every
             # in-flight and queued ticket's deadline clamps to the grace
-            self.scheduler.begin_drain(self.drain_deadline)
             if self.handoff:
-                # export every live slot as a DLREQ01 record the router
-                # fetches via GET /admin/export/<rid>; the requests'
-                # handlers see finish "handoff" and answer immediately,
-                # so the drain completes in O(export) rather than
-                # O(longest in-flight decode)
+                # drain-with-export in one scheduler call: every live
+                # slot becomes a DLREQ01 record the router fetches via
+                # GET /admin/export/<rid>; the requests' handlers see
+                # finish "handoff" and answer immediately, so the drain
+                # completes in O(export) rather than O(longest
+                # in-flight decode)
                 try:
                     self.handoff_records.update(
-                        self.scheduler.handoff_export_all())
+                        self.scheduler.drain_with_export(
+                            self.drain_deadline))
                 except Exception as e:
                     # a failed export degrades to a plain grace-bounded
                     # drain; it must never turn SIGTERM into a crash
                     _log.error("handoff_export_failed",
                                extra={"error": repr(e)})
+                    self.scheduler.begin_drain(self.drain_deadline)
+            else:
+                self.scheduler.begin_drain(self.drain_deadline)
 
     # -- engine-state snapshot (warm restart; runtime/snapshot.py) ------
     @property

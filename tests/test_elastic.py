@@ -1,0 +1,482 @@
+"""Elastic-pod tests (router/elastic.py + runtime registry membership).
+
+The unit tier is jax-free and subprocess-light: the policy is a pure
+function of synthetic signal windows (hysteresis, cooldown, the three
+decision directions), the device pool is plain accounting, the registry
+add/remove/retire surface mutates a real :class:`Registry` without its
+probe thread, and the controller runs against in-memory fakes so every
+scale/reshape path executes deterministically in milliseconds.  The
+port-hold fence and the supervisor's runtime add/remove/retiring
+behavior use real sockets and trivial child processes.
+
+The slow tier runs ``tools/chaos_drill.py --reshape --quick`` — a real
+supervised elastic pod doing a live 2×tp=1 → 2×tp=2 reshape with a
+SIGKILL landing mid-migration, asserting convergence, greedy byte
+parity through the migration, bounded unavailability, and zero KV
+leaks.
+"""
+
+import os
+import socket
+import sys
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from fixtures import REPO, free_port
+from dllama_tpu.router.elastic import (DevicePool, ElasticController,
+                                       ElasticPolicy)
+from dllama_tpu.router.pod import Supervisor, _Replica, _hold_port
+from dllama_tpu.router.registry import Registry
+
+pytestmark = pytest.mark.elastic
+
+_SLEEPER = [sys.executable, "-c", "import time; time.sleep(600)"]
+
+
+def _wait(cond, timeout=30.0, msg="condition never held"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(msg)
+
+
+# -- device pool ----------------------------------------------------------
+
+def test_device_pool_contiguous_then_fragmented():
+    """Allocation prefers a contiguous ordinal run; once scale churn
+    fragments the free set, the lowest free ordinals serve."""
+    pool = DevicePool(8)
+    a = pool.allocate(2)
+    b = pool.allocate(2)
+    c = pool.allocate(2)
+    assert a == [0, 1] and b == [2, 3] and c == [4, 5]
+    pool.release(b)                      # hole at 2,3
+    d = pool.allocate(4)                 # no contiguous 4-run left
+    assert d == [2, 3, 6, 7]
+    assert pool.free == 0
+
+
+def test_device_pool_exhaustion_and_double_release():
+    pool = DevicePool(2)
+    got = pool.allocate(2)
+    with pytest.raises(ValueError):
+        pool.allocate(1)                 # exhausted
+    pool.release(got)
+    with pytest.raises(ValueError):
+        pool.release([0])                # double release
+    with pytest.raises(ValueError):
+        pool.release([99])               # out of range
+    with pytest.raises(ValueError):
+        DevicePool(0)
+
+
+# -- policy (pure signal-window decisions) --------------------------------
+
+def _hot(util=0.95, q=5.0, kv=0.5):
+    return {"util": util, "queue_per_replica": q, "kv_free_frac": kv}
+
+
+def _cold(util=0.05, q=0.0, kv=0.9):
+    return {"util": util, "queue_per_replica": q, "kv_free_frac": kv}
+
+
+def _policy(**kw):
+    defaults = dict(window=3, cooldown=10.0, min_replicas=1,
+                    max_replicas=4)
+    defaults.update(kw)
+    return ElasticPolicy(**defaults)
+
+
+def test_policy_needs_full_window():
+    """No verdict until the window fills: two hot samples out of three
+    decide nothing."""
+    p = _policy()
+    p.observe(_hot())
+    p.observe(_hot())
+    assert p.decide(0.0, n_replicas=1, tp=1, free_devices=3) is None
+    p.observe(_hot())
+    d = p.decide(0.0, n_replicas=1, tp=1, free_devices=3)
+    assert d is not None and d.direction == "up" and d.reason == "load"
+
+
+def test_policy_hysteresis_one_cool_sample_blocks():
+    """A single non-hot sample inside the window vetoes scale-up — the
+    sustained-signal rule that keeps a spiky load from flapping."""
+    p = _policy()
+    p.observe(_hot())
+    p.observe({"util": 0.5, "queue_per_replica": 0.0,
+               "kv_free_frac": 0.5})
+    p.observe(_hot())
+    assert p.decide(0.0, n_replicas=1, tp=1, free_devices=3) is None
+
+
+def test_policy_cooldown_blocks_and_clears_window():
+    p = _policy()
+    for _ in range(3):
+        p.observe(_hot())
+    assert p.decide(100.0, n_replicas=1, tp=1, free_devices=3) is not None
+    p.note_action(100.0)
+    # the cooldown gates even a re-filled window...
+    for _ in range(3):
+        p.observe(_hot())
+    assert p.decide(105.0, n_replicas=1, tp=1, free_devices=3) is None
+    # ...and elapses
+    assert p.decide(111.0, n_replicas=1, tp=1,
+                    free_devices=3) is not None
+    # note_action cleared the pre-action samples: a fresh policy clock
+    p2 = _policy()
+    for _ in range(3):
+        p2.observe(_hot())
+    p2.note_action(0.0)
+    assert p2.decide(50.0, n_replicas=1, tp=1, free_devices=3) is None
+
+
+def test_policy_scale_down_and_min_floor():
+    p = _policy(min_replicas=2)
+    for _ in range(3):
+        p.observe(_cold())
+    d = p.decide(0.0, n_replicas=3, tp=1, free_devices=1)
+    assert d is not None and d.direction == "down" and d.reason == "idle"
+    for _ in range(3):
+        p.observe(_cold())
+    assert p.decide(0.0, n_replicas=2, tp=1, free_devices=2) is None
+
+
+def test_policy_up_capped_at_max():
+    p = _policy(max_replicas=2)
+    for _ in range(3):
+        p.observe(_hot())
+    assert p.decide(0.0, n_replicas=2, tp=1, free_devices=2) is None
+
+
+def test_policy_reshape_narrower_when_devices_exhausted():
+    """Hot fleet, zero free devices, tp>1: the answer is trading tp for
+    dp — reshape to half the degree instead of giving up."""
+    p = _policy()
+    for _ in range(3):
+        p.observe(_hot())
+    d = p.decide(0.0, n_replicas=2, tp=2, free_devices=0)
+    assert d is not None and d.direction == "reshape" and d.tp == 1
+    # at tp=1 there is nothing to trade: no decision
+    for _ in range(3):
+        p.observe(_hot())
+    assert p.decide(0.0, n_replicas=4, tp=1, free_devices=0) is None
+
+
+def test_policy_reshape_wider_on_kv_starvation():
+    p = _policy()
+    for _ in range(3):
+        p.observe(_hot(kv=0.01))
+    d = p.decide(0.0, n_replicas=4, tp=1, free_devices=0)
+    assert d is not None and d.direction == "reshape" \
+        and d.reason == "kv_pressure" and d.tp == 2
+    # blocked when doubling tp cannot seat min_replicas
+    p2 = _policy(min_replicas=2)
+    for _ in range(3):
+        p2.observe(_hot(kv=0.01))
+    assert p2.decide(0.0, n_replicas=2, tp=1, free_devices=0) is None
+
+
+# -- registry runtime membership ------------------------------------------
+
+def _registry(n=2):
+    reg = Registry([f"127.0.0.1:{10000 + i}" for i in range(n)],
+                   probe_interval=999.0)
+    for b in reg.backends:
+        b.last_health = {"status": "ok", "capacity": {"free_slots": 2}}
+    return reg
+
+
+def test_registry_runtime_add_gated_until_first_probe():
+    reg = _registry()
+    b = reg.add("127.0.0.1:10099")
+    assert reg.get("127.0.0.1:10099") is b
+    # no health yet: invisible to dispatch, invisible to `available`
+    assert b not in [reg.pick() for _ in range(4)]
+    assert reg.snapshot()["available"] == 2
+    b.last_health = {"status": "ok", "capacity": {"free_slots": 99}}
+    assert reg.pick() is b
+    assert reg.snapshot()["available"] == 3
+    with pytest.raises(ValueError):
+        reg.add("127.0.0.1:10099")       # duplicate
+
+
+def test_registry_retire_fences_dispatch_not_export():
+    reg = _registry()
+    victim = reg.backends[0]
+    reg.retire(victim.addr)
+    # never picked, not a hand-off import target, not "available"...
+    assert all(reg.pick() is not victim for _ in range(4))
+    assert victim not in reg.handoff_peers()
+    snap = reg.snapshot()
+    assert snap["available"] == 1
+    # ...but NOT ejected: still a live row (the drain's export source)
+    row = [r for r in snap["backends"] if r["addr"] == victim.addr][0]
+    assert row["retiring"] and not row["ejected"]
+
+
+def test_registry_remove_runtime():
+    reg = _registry()
+    gone = reg.backends[0].addr
+    assert reg.remove(gone) is not None
+    assert reg.get(gone) is None
+    assert reg.snapshot()["total"] == 1
+    assert reg.remove("127.0.0.1:59999") is None   # unknown: no-op
+
+
+# -- controller over fakes ------------------------------------------------
+
+class FakeRegistry:
+    """Registry seam the controller needs: membership + admission."""
+
+    def __init__(self, ports=()):
+        self.rows = {}
+        for p in ports:
+            self.add(f"127.0.0.1:{p}")
+
+    def add(self, addr):
+        if addr in self.rows:
+            raise ValueError(addr)
+        self.rows[addr] = SimpleNamespace(
+            addr=addr, last_health={"status": "ok"}, ejected=False,
+            retiring=False)
+
+    def remove(self, addr):
+        return self.rows.pop(addr, None)
+
+    def retire(self, addr):
+        if addr in self.rows:
+            self.rows[addr].retiring = True
+
+    def get(self, addr):
+        return self.rows.get(addr)
+
+    def score(self, b):
+        return 0.0
+
+    def eligible_backends(self):
+        return []
+
+
+class FakeOps:
+    """Replica mechanics without processes."""
+
+    def __init__(self, *, tp=1, n=2):
+        self.reps = [self._mk(i, tp, [i]) for i in range(n)]
+        self._next = n
+        self.retired = []
+
+    @staticmethod
+    def _mk(idx, tp, ordinals):
+        return SimpleNamespace(idx=idx, port=9000 + idx, tp=tp,
+                               ordinals=list(ordinals), retiring=False,
+                               quarantined=False)
+
+    def spawn(self, tp, ordinals):
+        rep = self._mk(self._next, tp, ordinals)
+        self._next += 1
+        self.reps.append(rep)
+        return rep
+
+    def retire(self, rep, *, grace):
+        rep.retiring = True
+        self.reps.remove(rep)
+        self.retired.append(rep)
+
+    def live_replicas(self):
+        return [r for r in self.reps if not r.quarantined]
+
+    def reap_quarantined(self):
+        out = [r for r in self.reps if r.quarantined]
+        for r in out:
+            self.reps.remove(r)
+        return out
+
+
+def _controller(*, tp=1, n=2, pool_total=4, min_replicas=1,
+                max_replicas=4):
+    ops = FakeOps(tp=tp, n=n)
+    reg = FakeRegistry(r.port for r in ops.reps)
+    pool = DevicePool(pool_total)
+    for r in ops.reps:                   # seat the boot shape
+        r.ordinals = pool.allocate(tp)
+    policy = ElasticPolicy(window=3, cooldown=0.0,
+                           min_replicas=min_replicas,
+                           max_replicas=max_replicas)
+    ctl = ElasticController(ops, reg, pool, policy, tp=tp,
+                            interval=0.01, drain_grace=0.1,
+                            boot_timeout=2.0)
+    return ctl, ops, reg, pool
+
+
+def test_controller_manual_scale_up_and_down():
+    ctl, ops, reg, pool = _controller(n=2, pool_total=4)
+    ctl.request_scale(4)
+    ctl._tick()                          # controller thread's step
+    assert len(ops.live_replicas()) == 4
+    assert pool.free == 0
+    assert len(reg.rows) == 4            # registered at runtime
+    ctl.request_scale(2)
+    ctl._tick()
+    assert len(ops.live_replicas()) == 2
+    assert pool.free == 2 and len(reg.rows) == 2
+    assert len(ops.retired) == 2         # drained, not dropped
+
+
+def test_controller_scale_clamps_to_bounds():
+    ctl, ops, _, _ = _controller(n=2, pool_total=4, min_replicas=2,
+                                 max_replicas=3)
+    ctl.request_scale(99)
+    ctl._tick()
+    assert len(ops.live_replicas()) == 3
+    ctl.request_scale(0)
+    ctl._tick()
+    assert len(ops.live_replicas()) == 2
+
+
+def test_controller_scale_up_blocked_without_devices():
+    ctl, ops, _, _ = _controller(n=2, pool_total=2)
+    ctl.request_scale(4)
+    ctl._tick()                          # pool empty: no spawn, no crash
+    assert len(ops.live_replicas()) == 2
+
+
+def test_controller_reshape_narrow_to_wide_and_back():
+    """4×tp=1 → 2×tp=2 over a full 4-device pool (must retire before it
+    can spawn), then back — the live-reshape interleave."""
+    ctl, ops, reg, pool = _controller(tp=1, n=4, pool_total=4)
+    ctl.request_reshape(2)
+    ctl._tick()
+    live = ops.live_replicas()
+    assert ctl.tp == 2
+    assert [r.tp for r in live] == [2, 2]
+    assert pool.free == 0 and len(reg.rows) == 2
+    ctl.request_reshape(1)
+    ctl._tick()
+    live = ops.live_replicas()
+    assert ctl.tp == 1 and len(live) == 4
+    assert all(r.tp == 1 for r in live)
+    assert pool.free == 0 and len(reg.rows) == 4
+
+
+def test_controller_reshape_rejects_oversized_tp():
+    ctl, _, _, _ = _controller(tp=1, n=2, pool_total=4)
+    with pytest.raises(ValueError):
+        ctl.request_reshape(8)           # exceeds the device budget
+    with pytest.raises(ValueError):
+        ctl.request_reshape(0)
+
+
+def test_controller_reaps_quarantined_replica():
+    ctl, ops, reg, pool = _controller(n=3, pool_total=4)
+    victim = ops.reps[1]
+    victim.quarantined = True
+    ctl._tick()
+    assert victim not in ops.reps
+    assert f"127.0.0.1:{victim.port}" not in reg.rows
+    assert pool.free == 2                # 1 spare + the reclaimed seat
+
+
+def test_controller_never_retires_last_replica():
+    ctl, ops, _, _ = _controller(n=1, pool_total=2)
+    assert ctl._retire_one("test") is False
+    assert len(ops.live_replicas()) == 1
+
+
+def test_controller_fleet_status_shape():
+    ctl, _, _, _ = _controller(tp=1, n=2, pool_total=4)
+    fs = ctl.fleet_status()
+    assert fs["elastic"] is True and fs["tp"] == 1
+    assert fs["n_replicas"] == 2 and fs["busy"] is None
+    assert fs["device_pool"] == {"total": 4, "free": 2}
+    assert [r["tp"] for r in fs["replicas"]] == [1, 1]
+
+
+# -- port-hold fence + supervisor runtime membership ----------------------
+
+def test_hold_port_fences_the_bind_race():
+    """While the allocation socket is held, nobody can steal the port;
+    Supervisor.spawn releases it in the instant before the child
+    starts."""
+    port, held = _hold_port()
+    thief = socket.socket()
+    try:
+        with pytest.raises(OSError):
+            thief.bind(("127.0.0.1", port))
+    finally:
+        thief.close()
+    rep = _Replica(0, port, list(_SLEEPER), dict(os.environ), sock=held)
+    sup = Supervisor([rep], poll_interval=0.05, probe_timeout=0.5)
+    sup.spawn(rep)
+    try:
+        assert rep.sock is None          # fence released at spawn
+        assert held.fileno() == -1       # and actually closed
+        reclaim = socket.socket()
+        try:
+            reclaim.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            reclaim.bind(("127.0.0.1", port))
+        finally:
+            reclaim.close()
+    finally:
+        rep.proc.kill()
+        rep.proc.wait(timeout=10)
+
+
+def test_supervisor_runtime_add_remove():
+    rep0 = _Replica(0, free_port(), list(_SLEEPER), dict(os.environ))
+    sup = Supervisor([rep0], poll_interval=0.05, probe_timeout=0.5)
+    sup.start()
+    try:
+        rep1 = _Replica(1, free_port(), list(_SLEEPER), dict(os.environ))
+        sup.add(rep1)
+        assert rep1.proc is not None and rep1.proc.poll() is None
+        assert sup.replicas_up() == 2
+        rep1.retiring = True
+        rep1.proc.kill()
+        rep1.proc.wait(timeout=10)
+        sup.remove(rep1)
+        assert sup.replicas_up() == 1
+        assert len(sup.snapshot()) == 1
+    finally:
+        sup.stop()
+
+
+def test_supervisor_skips_retiring_replica_death():
+    """A retiring replica's exit is drain completion, not a death: no
+    respawn, no crash-loop accounting."""
+    rep = _Replica(0, free_port(), list(_SLEEPER), dict(os.environ))
+    sup = Supervisor([rep], poll_interval=0.05, probe_timeout=0.5)
+    sup.start()
+    try:
+        _wait(lambda: rep.proc is not None and rep.proc.poll() is None)
+        rep.retiring = True
+        pid = rep.proc.pid
+        rep.proc.kill()
+        rep.proc.wait(timeout=10)
+        time.sleep(0.3)                  # several watch-loop passes
+        assert rep.proc.pid == pid       # same dead process: no respawn
+        assert len(rep.deaths) == 0
+        assert not rep.quarantined
+    finally:
+        sup.stop()
+
+
+# -- the reshape chaos soak (tools/chaos_drill.py --reshape) --------------
+
+@pytest.mark.slow
+def test_reshape_chaos_drill_quick():
+    """Live 2×tp=1 → 2×tp=2 reshape on a real supervised elastic pod
+    with a SIGKILL mid-migration: convergence, greedy byte parity
+    through the hand-off/resume ladder, bounded unavailability, zero
+    KV-page leaks."""
+    tools = os.path.join(REPO, "tools")
+    sys.path.insert(0, tools)
+    try:
+        from chaos_drill import run_reshape_drill
+    finally:
+        sys.path.remove(tools)
+    assert run_reshape_drill(quick=True) == 0

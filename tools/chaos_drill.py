@@ -128,7 +128,8 @@ class Pod:
     replica children) on the tiny fixture model."""
 
     def __init__(self, model: str, tok: str, *, dp: int = 2,
-                 snapshot_dir: str | None = None, faults: str = ""):
+                 snapshot_dir: str | None = None, faults: str = "",
+                 extra: list[str] | None = None):
         from fixtures import cpu_env, free_port
         self.dp = dp
         self.port = free_port()
@@ -155,6 +156,8 @@ class Pod:
                 "--respawn-max", "20", "--respawn-window", "60"]
         if snapshot_dir:
             argv += ["--snapshot-dir", snapshot_dir]
+        if extra:
+            argv += extra
         self.proc = subprocess.Popen(argv, cwd=REPO, env=env,
                                      stdout=subprocess.PIPE,
                                      stderr=subprocess.STDOUT, text=True)
@@ -445,11 +448,220 @@ def run_drill(*, quick: bool) -> int:
     return 0
 
 
+def post(base: str, path: str, timeout: float = 10.0) -> dict:
+    req = urllib.request.Request(base + path, data=b"", method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _reshape_converged(base: str, tp_want: int, n_want: int) -> bool:
+    """The pod finished a reshape when the fleet block, the registry,
+    and every backend's OWN mesh all agree on the new shape."""
+    try:
+        h = get(base, "/health", 2)
+    except OSError:
+        return False
+    fl = h.get("fleet") or {}
+    reps = fl.get("replicas") or []
+    if fl.get("tp") != tp_want or fl.get("busy") is not None:
+        return False
+    if len(reps) != n_want or any(
+            r["tp"] != tp_want or r["retiring"] for r in reps):
+        return False
+    if h.get("available", 0) < n_want:
+        return False
+    for row in h.get("backends") or []:
+        p = int(row["addr"].rpartition(":")[2])
+        try:
+            mesh = get(f"http://127.0.0.1:{p}", "/health", 2).get(
+                "mesh") or {}
+        except OSError:
+            return False
+        if mesh.get("tp") != tp_want:
+            return False
+    return True
+
+
+def run_reshape_drill(*, quick: bool) -> int:
+    """Elastic-pod chaos: live 2×tp=1 → tp=2 reshape with a SIGKILL
+    landing mid-migration (and the reverse reshape in full mode).
+    Asserts the reshape converges, migrated greedy streams stay
+    byte-identical to the solo oracle (PR 14 resume ladder), and no KV
+    pages leak."""
+    from fixtures import write_tiny_model, write_tiny_tokenizer
+
+    n_parity = 2 if quick else 4
+    failures: list[str] = []
+
+    def check(cond: bool, msg: str) -> None:
+        mark = "✅" if cond else "❌"
+        print(f"{mark} {msg}")
+        if not cond:
+            failures.append(msg)
+
+    with tempfile.TemporaryDirectory() as d:
+        model, tok = os.path.join(d, "tiny.m"), os.path.join(d, "tiny.t")
+        write_tiny_model(model)
+        write_tiny_tokenizer(tok)
+        pod = Pod(model, tok, dp=2,
+                  snapshot_dir=os.path.join(d, "snap"),
+                  # stretch decode so the SIGKILL lands mid-stream
+                  faults="engine.device_step=delay:0.05",
+                  # elastic with the policy neutered (impossible
+                  # thresholds): only the drill's /admin commands act,
+                  # so the reshape window is deterministic
+                  extra=["--elastic", "--pod-devices", "4",
+                         "--min-replicas", "1", "--max-replicas", "4",
+                         "--elastic-interval", "0.2",
+                         "--scale-up-util", "2", "--scale-down-util",
+                         "-1", "--scale-up-queue", "1000000",
+                         "--reshape-kv-low", "-1",
+                         "--drain-grace", "60"])
+        try:
+            t0 = time.monotonic()
+            pod.wait_ready()
+            print(f"fleet up in {time.monotonic() - t0:.0f}s "
+                  f"(router {pod.base}, replicas {pod.backend_ports()})")
+
+            # solo greedy oracle before any chaos (tp=1 replicas; the
+            # tp-serving tier proves greedy parity across tp degrees)
+            oracle, fin = stream_once(pod.base, GREEDY_BODY)
+            assert fin in ("stop", "length") and oracle, (fin, oracle)
+
+            sampler = AvailabilitySampler(pod.base)
+            sampler.start()
+
+            parity: list[tuple[str, str | None] | Exception] = []
+            chaos_done = threading.Event()
+            live: dict = {}
+
+            def parity_loop():
+                while not (chaos_done.is_set()
+                           and len(parity) >= n_parity):
+                    if len(parity) >= n_parity * 10:  # runaway guard
+                        break
+                    try:
+                        parity.append(stream_once(
+                            pod.base, GREEDY_BODY, live))
+                    except Exception as e:  # noqa: BLE001 — asserted
+                        parity.append(e)
+
+            pt = threading.Thread(target=parity_loop, daemon=True)
+            pt.start()
+
+            # wait for at least one in-flight stream, then reshape
+            deadline = time.monotonic() + 60
+            while not live.get("chars") and time.monotonic() < deadline:
+                time.sleep(0.1)
+            print("🔁 POST /admin/reshape?tp=2 (in-flight streams live)")
+            out = post(pod.base, "/admin/reshape?tp=2")
+            check(out.get("accepted") is True,
+                  f"reshape command accepted: {out}")
+
+            # SIGKILL a decoding replica while the reshape is running
+            time.sleep(1.0)
+            killed = False
+            deadline = time.monotonic() + 30
+            while not killed and time.monotonic() < deadline:
+                port = pod.active_port()
+                if port is None:
+                    time.sleep(0.2)
+                    continue
+                killed = pod.kill_replica(port, signal.SIGKILL)
+            check(killed, "SIGKILL landed on a decoding replica "
+                          "mid-reshape")
+
+            # convergence: everything agrees the fleet is 2×tp=2
+            deadline = time.monotonic() + (240 if quick else 420)
+            while time.monotonic() < deadline:
+                if _reshape_converged(pod.base, 2, 2):
+                    break
+                time.sleep(1.0)
+            check(_reshape_converged(pod.base, 2, 2),
+                  "reshape converged to 2×tp=2 despite the SIGKILL")
+
+            if not quick:
+                print("🔁 POST /admin/reshape?tp=1 (reverse)")
+                post(pod.base, "/admin/reshape?tp=1")
+                deadline = time.monotonic() + 420
+                while time.monotonic() < deadline:
+                    if _reshape_converged(pod.base, 1, 4):
+                        break
+                    time.sleep(1.0)
+                check(_reshape_converged(pod.base, 1, 4),
+                      "reverse reshape converged to 4×tp=1")
+
+            chaos_done.set()
+            pt.join(300)
+            sampler.stop()
+
+            # zero wrong bytes on the migrated greedy streams
+            bad = [p for p in parity
+                   if isinstance(p, Exception)
+                   or p[1] not in ("stop", "length") or p[0] != oracle]
+            check(not bad,
+                  f"greedy byte parity through reshape: "
+                  f"{len(parity) - len(bad)}/{len(parity)} streams "
+                  f"identical to oracle"
+                  + (f" (bad: {bad[:2]})" if bad else ""))
+
+            # bounded unavailability through reshape + murder
+            wins = sampler.windows()
+            p95 = _pct(wins, 0.95)
+            check(p95 <= 15.0 and max(wins, default=0.0) <= 45.0,
+                  f"unavailability bounded: p95={p95:.1f}s "
+                  f"max={max(wins, default=0.0):.1f}s "
+                  f"({len(wins)} windows)")
+
+            m = get(pod.base, "/metrics")
+            events = m.get("pod_scale_events") or {}
+            check(any(k.startswith("reshape") for k in events),
+                  f"reshape recorded in pod_scale_events: {events}")
+
+            # zero leaked KV pages on the surviving (new-shape) fleet
+            leaks = []
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                leaks = []
+                for p in pod.backend_ports():
+                    try:
+                        occ = get(f"http://127.0.0.1:{p}",
+                                  "/health", 2).get("scheduler") or {}
+                    except OSError:
+                        leaks.append((p, "unreachable"))
+                        continue
+                    if occ.get("active") or occ.get("queued") \
+                            or occ.get("parked") \
+                            or occ.get("kv_pages_free") \
+                            != occ.get("kv_pages_total"):
+                        leaks.append((p, occ))
+                if not leaks:
+                    break
+                time.sleep(1.0)
+            check(not leaks,
+                  "zero leaked KV pages after reshape"
+                  + (f" (leaks: {leaks[:2]})" if leaks else ""))
+        finally:
+            pod.stop()
+
+    if failures:
+        print(f"\n{len(failures)} reshape-chaos assertion(s) FAILED")
+        return 1
+    print("\nreshape chaos drill passed")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--quick", action="store_true",
                     help="single-kill smoke instead of the full soak")
+    ap.add_argument("--reshape", action="store_true",
+                    help="elastic-pod variant: SIGKILL a replica "
+                         "DURING a live tp reshape and assert "
+                         "convergence + byte parity + zero KV leaks")
     args = ap.parse_args(argv)
+    if args.reshape:
+        return run_reshape_drill(quick=args.quick)
     return run_drill(quick=args.quick)
 
 

@@ -19,9 +19,15 @@ Two subcommands against a live replica (or the fleet router):
     drill can assert "interactive held its budget while batch absorbed
     the overload".
 
-Without ``--trace``, replay synthesizes an open-loop Poisson-ish trace
-(``--requests`` arrivals at ``--rate`` per second), which is the usual
-way to push a replica past capacity without first recording one.
+Without ``--trace``, replay synthesizes an open-loop trace
+(``--requests`` arrivals at ``--rate`` per second) whose arrival curve
+``--shape`` picks: constant ``poisson``, a sinusoidal ``diurnal``
+quiet→peak→quiet cycle, or an on/off ``burst`` square wave — the
+acceptance shapes an elastic pod must ride without dropping requests.
+``--slo`` gives every class its own TTFT budget and verdict, and
+``--availability-p95-s`` samples ``/health`` throughout the replay and
+bounds the p95 unavailability window, so "the fleet stayed up while it
+reshaped" is measured, not asserted.
 
 Usage::
 
@@ -31,14 +37,16 @@ Usage::
         --trace /tmp/trace.json --speed 2 \
         --mix interactive=0.2,standard=0.3,batch=0.5 --slo-ttft-ms 2000
 
-Stdlib-only; exit code 0 iff every class with a configured SLO budget
-met it (always 0 when no budget was given).
+Stdlib-only; exit code 0 iff every configured bound held — each class
+with an SLO budget met it AND the availability p95 stayed within its
+bound (always 0 when nothing was configured).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import random
 import sys
 import threading
@@ -81,18 +89,109 @@ def record_trace(base: str, n: int = 500) -> dict:
 
 
 def synth_trace(n: int, rate: float, *, max_tokens: int = 16,
-                prompt_tokens: int = 8, seed: int = 0) -> dict:
-    """Open-loop arrivals: exponential gaps at ``rate``/s (deterministic
-    under ``seed`` so drills are reproducible)."""
+                prompt_tokens: int = 8, seed: int = 0,
+                shape: str = "poisson", period: float = 20.0) -> dict:
+    """Open-loop arrivals, deterministic under ``seed``.  ``shape``
+    picks the arrival-rate curve (the elastic-pod acceptance shapes):
+
+    * ``poisson`` — constant ``rate``/s (exponential gaps)
+    * ``diurnal`` — sinusoidal swing between 10% and 100% of ``rate``
+      over each ``period`` seconds: a compressed day, quiet → peak →
+      quiet, which is the load curve that should trigger one scale-up
+      and one scale-down per cycle
+    * ``burst`` — square wave: full ``rate`` for the first quarter of
+      each ``period``, 5% between bursts — the pathological on/off
+      pattern that punishes a policy with no hysteresis
+    """
+    if shape not in ("poisson", "diurnal", "burst"):
+        raise ValueError(f"unknown arrival shape {shape!r}; expected "
+                         "poisson|diurnal|burst")
     rng = random.Random(seed)
+    period = max(period, 1e-3)
+
+    def rate_at(t: float) -> float:
+        if shape == "diurnal":
+            swing = 0.5 * (1.0 - math.cos(2.0 * math.pi * t / period))
+            return max(rate * (0.1 + 0.9 * swing), 1e-3)
+        if shape == "burst":
+            return rate if (t % period) < period / 4.0 \
+                else max(rate * 0.05, 1e-3)
+        return rate
+
     t, rows = 0.0, []
     for _ in range(max(1, n)):
         rows.append({"offset_s": round(t, 6),
                      "prompt_tokens": prompt_tokens,
                      "max_tokens": max_tokens,
                      "priority": "standard"})
-        t += rng.expovariate(rate) if rate > 0 else 0.0
-    return {"version": 1, "requests": rows}
+        t += rng.expovariate(rate_at(t)) if rate > 0 else 0.0
+    return {"version": 1, "shape": shape, "requests": rows}
+
+
+def parse_slo(spec: str) -> dict[str, float]:
+    """``interactive=1500,standard=5000`` → per-class TTFT p95 budgets
+    in milliseconds."""
+    out = {}
+    for part in spec.split(","):
+        name, _, v = part.partition("=")
+        name = name.strip().lower()
+        if name not in PRIORITIES:
+            raise ValueError(f"unknown priority class {name!r} in --slo; "
+                             f"expected {'|'.join(PRIORITIES)}")
+        out[name] = float(v)
+    return out
+
+
+class AvailabilitySampler(threading.Thread):
+    """Polls ``GET /health`` while the replay runs and measures
+    unavailability *windows* (consecutive failed samples count as one
+    outage of their combined length), so the report can bound
+    availability-p95 instead of asserting it."""
+
+    def __init__(self, base: str, interval: float = 0.25):
+        super().__init__(daemon=True, name="availability-sampler")
+        self.base = base
+        self.interval = interval
+        self.samples = 0
+        self.windows: list[float] = []
+        self._down_since: float | None = None
+        self._stop_evt = threading.Event()
+
+    def run(self) -> None:
+        while not self._stop_evt.is_set():
+            try:
+                h = _get_json(self.base, "/health", timeout=2.0)
+                ok = bool(h.get("ready", h.get("status") == "ok"))
+            except Exception:
+                ok = False
+            now = time.monotonic()
+            self.samples += 1
+            if ok and self._down_since is not None:
+                self.windows.append(now - self._down_since)
+                self._down_since = None
+            elif not ok and self._down_since is None:
+                self._down_since = now
+            self._stop_evt.wait(self.interval)
+
+    def stop(self) -> None:
+        if self._down_since is not None:
+            self.windows.append(time.monotonic() - self._down_since)
+            self._down_since = None
+        self._stop_evt.set()
+
+    def report(self, bound_p95_s: float | None = None) -> dict:
+        w = sorted(self.windows)
+        rep = {
+            "samples": self.samples,
+            "unavailable_windows": len(w),
+            "unavailable_p95_s": round(_pct(w, 0.95), 3) if w else 0.0,
+            "unavailable_max_s": round(w[-1], 3) if w else 0.0,
+        }
+        if bound_p95_s is not None:
+            rep["bound_p95_s"] = bound_p95_s
+            rep["verdict"] = "pass" \
+                if rep["unavailable_p95_s"] <= bound_p95_s else "fail"
+        return rep
 
 
 def parse_mix(spec: str) -> list[tuple[str, float]]:
@@ -212,15 +311,28 @@ def _counter_totals(metrics: dict) -> dict:
 def replay_trace(base: str, trace: dict, *, speed: float = 1.0,
                  mix: str | None = None, seed: int = 0,
                  timeout: float = 240.0,
-                 slo_ttft_ms: float | None = None) -> dict:
+                 slo_ttft_ms: float | None = None,
+                 slo_ms: dict[str, float] | None = None,
+                 availability_bound_s: float | None = None,
+                 sample_availability: bool = False) -> dict:
     """Replay ``trace`` against ``base`` and return the report dict
-    (also the library entry point used by tests and fault drills)."""
+    (also the library entry point used by tests and fault drills).
+    ``slo_ms`` carries per-class TTFT p95 budgets (``parse_slo``);
+    ``slo_ttft_ms`` is the interactive-only legacy spelling.  With
+    ``availability_bound_s`` (or ``sample_availability``) a sampler
+    thread polls ``/health`` throughout and the report gains an
+    ``availability`` block with an unavailability-window p95 — and a
+    pass/fail verdict against the bound."""
     rows = trace.get("requests") or []
     if not rows:
         raise ValueError("trace has no requests")
     rng = random.Random(seed)
     mix_cum = parse_mix(mix) if mix else None
     before = _counter_totals(_get_json(base, "/metrics"))
+    sampler = None
+    if availability_bound_s is not None or sample_availability:
+        sampler = AvailabilitySampler(base)
+        sampler.start()
 
     results: list[_Result] = []
     lock = threading.Lock()
@@ -241,6 +353,9 @@ def replay_trace(base: str, trace: dict, *, speed: float = 1.0,
     for t in threads:
         t.join(timeout)
     wall = time.monotonic() - t_start
+    if sampler is not None:
+        sampler.stop()
+        sampler.join(timeout=2.0)
 
     after = _counter_totals(_get_json(base, "/metrics"))
     deltas = {k: after.get(k, 0) - before.get(k, 0)
@@ -272,9 +387,14 @@ def replay_trace(base: str, trace: dict, *, speed: float = 1.0,
             "itl_p50_ms": round(_pct(itls, 0.5) * 1e3, 1) if itls else None,
             "itl_p95_ms": round(_pct(itls, 0.95) * 1e3, 1) if itls else None,
         }
-        if slo_ttft_ms is not None and name == "interactive":
+        budget = (slo_ms or {}).get(name)
+        if budget is None and slo_ttft_ms is not None \
+                and name == "interactive":
+            budget = slo_ttft_ms
+        if budget is not None:
+            row["slo_budget_ms"] = budget
             row["slo_verdict"] = (
-                "pass" if ttfts and row["ttft_p95_ms"] <= slo_ttft_ms
+                "pass" if ttfts and row["ttft_p95_ms"] <= budget
                 else "fail")
         classes[name] = row
 
@@ -282,9 +402,23 @@ def replay_trace(base: str, trace: dict, *, speed: float = 1.0,
         slo = (_get_json(base, "/health").get("slo") or {}).get("status")
     except Exception:
         slo = None
-    return {"base": base, "speed": speed, "wall_s": round(wall, 3),
-            "requests": len(rows), "classes": classes,
-            "metric_deltas": deltas, "server_slo_status": slo}
+    report = {"base": base, "speed": speed, "wall_s": round(wall, 3),
+              "requests": len(rows), "classes": classes,
+              "metric_deltas": deltas, "server_slo_status": slo}
+    if sampler is not None:
+        report["availability"] = sampler.report(availability_bound_s)
+    return report
+
+
+def report_verdicts(report: dict) -> list[str]:
+    """Every pass/fail verdict the report carries — per-class SLO plus
+    the availability bound — so callers gate on one list."""
+    out = [c["slo_verdict"] for c in report["classes"].values()
+           if "slo_verdict" in c]
+    avail = report.get("availability") or {}
+    if "verdict" in avail:
+        out.append(avail["verdict"])
+    return out
 
 
 def print_report(report: dict) -> None:
@@ -304,6 +438,14 @@ def print_report(report: dict) -> None:
             print(f"    {k:<40} +{v}")
     if report.get("server_slo_status"):
         print(f"  server SLO status: {report['server_slo_status']}")
+    avail = report.get("availability")
+    if avail:
+        verdict = f"  verdict={avail['verdict']}" if "verdict" in avail \
+            else ""
+        print(f"  availability: {avail['samples']} samples, "
+              f"{avail['unavailable_windows']} outage window(s), "
+              f"p95={avail['unavailable_p95_s']}s "
+              f"max={avail['unavailable_max_s']}s{verdict}")
 
 
 def main(argv=None) -> int:
@@ -331,11 +473,27 @@ def main(argv=None) -> int:
                      help="synthetic trace size (no --trace)")
     rep.add_argument("--rate", type=float, default=8.0,
                      help="synthetic arrivals per second (no --trace)")
+    rep.add_argument("--shape", choices=["poisson", "diurnal", "burst"],
+                     default="poisson",
+                     help="synthetic arrival-rate curve (no --trace): "
+                          "constant, sinusoidal quiet→peak→quiet, or "
+                          "on/off square wave")
+    rep.add_argument("--shape-period", type=float, default=20.0,
+                     help="seconds per diurnal/burst cycle in trace "
+                          "time (divide by --speed for wall time)")
     rep.add_argument("--max-tokens", type=int, default=16)
     rep.add_argument("--seed", type=int, default=0)
     rep.add_argument("--timeout", type=float, default=240.0)
     rep.add_argument("--slo-ttft-ms", type=float, default=None,
                      help="interactive TTFT p95 budget for the verdict")
+    rep.add_argument("--slo", default=None,
+                     help="per-class TTFT p95 budgets (ms), e.g. "
+                          "interactive=1500,standard=5000 — each named "
+                          "class gets its own pass/fail verdict")
+    rep.add_argument("--availability-p95-s", type=float, default=None,
+                     help="sample /health throughout and fail unless "
+                          "the p95 unavailability window is within "
+                          "this many seconds")
     rep.add_argument("--json", action="store_true",
                      help="emit the raw report dict instead of text")
     args = ap.parse_args(argv)
@@ -352,16 +510,18 @@ def main(argv=None) -> int:
             trace = json.load(f)
     else:
         trace = synth_trace(args.requests, args.rate,
-                            max_tokens=args.max_tokens, seed=args.seed)
+                            max_tokens=args.max_tokens, seed=args.seed,
+                            shape=args.shape, period=args.shape_period)
     report = replay_trace(args.base, trace, speed=args.speed, mix=args.mix,
                           seed=args.seed, timeout=args.timeout,
-                          slo_ttft_ms=args.slo_ttft_ms)
+                          slo_ttft_ms=args.slo_ttft_ms,
+                          slo_ms=parse_slo(args.slo) if args.slo else None,
+                          availability_bound_s=args.availability_p95_s)
     if args.json:
         print(json.dumps(report, indent=1))
     else:
         print_report(report)
-    verdicts = [c.get("slo_verdict") for c in report["classes"].values()]
-    return 1 if "fail" in verdicts else 0
+    return 1 if "fail" in report_verdicts(report) else 0
 
 
 if __name__ == "__main__":
