@@ -838,7 +838,35 @@ def _bench_sched_spec(cfg, slots=4, max_new=96, spec_k=4):
     return {"off": run_mode(False), "spec": run_mode(True)}
 
 
+def _bank_stage_metrics(name):
+    """Append this stage's final metrics-registry snapshot (obs/metrics
+    .py, the same families /metrics serves) to the BENCH_METRICS_BANK
+    JSONL artifact — stdout stays the one-JSON-line result contract, so
+    the observability evidence rides in a side file next to
+    BENCH_r{N}.json instead."""
+    path = os.environ.get("BENCH_METRICS_BANK")
+    if not path:
+        return
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from dllama_tpu.obs import metrics as obs_metrics
+        line = json.dumps({"stage": name, "ts": round(time.time(), 3),
+                           "metrics": obs_metrics.snapshot_json()})
+        with open(path, "a") as f:
+            f.write(line + "\n")
+    except Exception as e:  # noqa: BLE001 — evidence, never the number
+        print(f"bench: metrics bank failed for {name}: {e}",
+              file=sys.stderr)
+
+
 def run_attempt(name):
+    try:
+        _attempt_body(name)
+    finally:
+        _bank_stage_metrics(name)
+
+
+def _attempt_body(name):
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     # bench children log like the server does (DLLAMA_LOG honored); all
     # dllama logging goes to stderr, so the one-JSON-line stdout contract
@@ -1282,6 +1310,17 @@ def _relay_up(attempts: int = 3, delay_s: float = 5.0) -> bool:
 def main():
     t_start = time.time()
     _install_term_handler()
+
+    # per-stage metrics bank: every attempt child appends its final
+    # registry snapshot (one JSON line per stage) here — the federated
+    # observability artifact that lands next to BENCH_r{N}.json
+    bank = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_metrics.jsonl")
+    try:
+        os.unlink(bank)
+    except OSError:
+        pass
+    os.environ["BENCH_METRICS_BANK"] = bank
 
     def remaining():
         return BUDGET_S - (time.time() - t_start)

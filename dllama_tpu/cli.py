@@ -444,6 +444,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--flight-buffer", type=int, default=None,
                    help="flight-recorder ring capacity for /debug/requests "
                         "(default DLLAMA_FLIGHT_BUFFER, else 512)")
+    p.add_argument("--event-buffer", type=int, default=None,
+                   help="event-journal ring capacity for /debug/events "
+                        "(default DLLAMA_EVENT_BUFFER, else 2048)")
+    p.add_argument("--event-log", default=None, metavar="PATH",
+                   help="also append every event-journal record as a JSONL "
+                        "line to PATH (append mode — restarts extend), so "
+                        "spawn/quarantine/scale/reshape incidents survive "
+                        "the process that emitted them")
     p.add_argument("--slo", default=None, metavar="SPEC",
                    help="declarative latency/error objectives, e.g. "
                         "'ttft_p95=1500ms,itl_p99=120ms,error_rate=0.5%%'. "
@@ -814,9 +822,11 @@ def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
     from .obs.log import configure as configure_logging
     configure_logging(args.log_format, args.log_level)
-    from .obs import flight as obs_flight, trace as obs_trace
+    from .obs import events as obs_events, flight as obs_flight, \
+        trace as obs_trace
     obs_trace.configure(args.trace_buffer)
     obs_flight.configure(args.flight_buffer)
+    obs_events.configure(args.event_buffer, args.event_log)
     # validate --slo up front (a bad spec must not surface only after a
     # long run); the engine is consulted again by _print_slo_summary
     spec = args.slo or os.environ.get("DLLAMA_SLO", "")

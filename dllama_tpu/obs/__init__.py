@@ -26,6 +26,11 @@ Three stdlib-only building blocks, threaded through every layer:
 * :mod:`.slo` — declarative latency/error objectives with rolling
   multi-window burn rates (``--slo`` / ``DLLAMA_SLO``), feeding
   ``slo_burn_rate`` gauges and the ``/health`` verdict.
+* :mod:`.events` — the pod event journal: bounded, monotonically-
+  sequenced structured lifecycle events (spawn/respawn/quarantine/
+  scale/reshape/hand-off/preempt…), served at ``/debug/events`` with a
+  ``?since=<seq>`` cursor and optionally persisted as JSONL
+  (``--event-log``).
 
 Nothing here imports jax (or anything beyond the stdlib): the engine,
 loaders, and server all import ``obs`` freely with no cycle risk, and a
@@ -34,4 +39,4 @@ metric bump on the decode hot path costs one small lock.
 
 from __future__ import annotations
 
-from . import dispatch, flight, log, metrics, slo, trace  # noqa: F401
+from . import dispatch, events, flight, log, metrics, slo, trace  # noqa: F401

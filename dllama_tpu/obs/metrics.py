@@ -814,3 +814,29 @@ POD_RESHAPE_SECONDS = REGISTRY.histogram(
     "Wall time of one live tp reshape, first spawn/retire to "
     "convergence — every in-flight request migrated, all replicas on "
     "the new shape.")
+
+# fleet observability plane (obs/events.py + router federation).  The
+# event counter lives in whichever process emitted the event (router
+# for spawn/eject/scale, replica for preempt/resume/handoff); the
+# fleet_* families live only in the router/pod process, bumped by the
+# federating scraper itself.
+POD_EVENTS = REGISTRY.labeled_counter(
+    "pod_events", ("kind",),
+    "Structured events appended to this process's event journal "
+    "(/debug/events), by kind: spawn, death, respawn, quarantine, "
+    "eject, readmit, retire, scale, reshape, handoff, resume, "
+    "preempt.")
+FLEET_REPLICA_UP = REGISTRY.labeled_gauge(
+    "fleet_replica_up", ("replica",),
+    "Federated-scrape reachability per registered replica: 1 = the "
+    "last fleet /metrics scrape of this replica succeeded, 0 = it "
+    "failed or timed out (the replica is still listed, marked stale, "
+    "never silently dropped).")
+FLEET_SCRAPE_ERRORS = REGISTRY.labeled_counter(
+    "fleet_scrape_errors", ("replica",),
+    "Failed or timed-out per-replica scrapes during fleet /metrics "
+    "federation, by replica address.")
+FLEET_SCRAPE_SECONDS = REGISTRY.histogram(
+    "fleet_scrape_seconds", (0.005, 0.02, 0.05, 0.1, 0.25, 1.0, 5.0),
+    "Wall time of one whole federated /metrics fan-out (all replicas "
+    "scraped concurrently, slowest replica dominates).")

@@ -11,18 +11,32 @@ heavyweight XLA-level tool.
 
 Timestamps are ``time.perf_counter()`` seconds (converted to µs in the
 export); they order and measure correctly within one process but are not
-wall-clock.  Capacity comes from ``--trace-buffer`` /
-``DLLAMA_TRACE_BUFFER`` (legacy alias ``DLLAMA_TRACE_CAPACITY``;
-default 8192 spans ≈ a few hundred requests); a malformed value warns
-once and falls back, mirroring the ``DLLAMA_Q40_BLOCK_TILES`` contract.
+wall-clock.  The ``raw()`` export therefore samples ``(perf_now,
+wall_now)`` at serve time so a cross-process stitcher (the router's
+``/debug/trace?scope=fleet``) can compute a per-replica offset and shift
+every ring onto one wall-clock axis.  Capacity comes from
+``--trace-buffer`` / ``DLLAMA_TRACE_BUFFER`` (legacy alias
+``DLLAMA_TRACE_CAPACITY``; default 8192 spans ≈ a few hundred requests);
+a malformed value warns once and falls back, mirroring the
+``DLLAMA_Q40_BLOCK_TILES`` contract.
+
+Fleet trace context: ``X-Dllama-Trace`` carries one id for a request's
+whole life across router hops and DLREQ01 migrations.  The id rides a
+contextvar for the accepting thread plus a bounded rid→trace map
+(``set_trace``/``trace_of``) for threads that work on behalf of another
+request (the scheduler loop stamps spans with an explicit ``rid``, and
+the map resolves those to the trace id without touching call sites).
 """
 
 from __future__ import annotations
 
+import contextvars
 import os
+import re
 import threading
 import time
-from collections import deque
+import uuid
+from collections import OrderedDict, deque
 from contextlib import contextmanager
 
 from .log import get_logger, request_id_var
@@ -32,6 +46,55 @@ _log = get_logger("obs.trace")
 DEFAULT_CAPACITY = 8192
 
 _warned_specs: set = set()
+
+# ---------------------------------------------------------------------------
+# Fleet trace context (X-Dllama-Trace)
+# ---------------------------------------------------------------------------
+
+#: header value charset — same shape as request ids so proxies/log greps
+#: treat them alike; anything else is stripped at the trust boundary.
+_TRACE_RE = re.compile(r"[^A-Za-z0-9._-]")
+_TRACE_MAX = 64
+
+#: ambient trace id for the thread/task that accepted the request.
+trace_id_var: contextvars.ContextVar = contextvars.ContextVar(
+    "dllama_trace_id", default=None)
+
+#: rid → trace id, bounded LRU so abandoned requests can't grow it.
+_RID_TRACE_CAP = 4096
+_rid_trace: OrderedDict = OrderedDict()
+_rid_trace_lock = threading.Lock()
+
+
+def new_trace_id() -> str:
+    """A fresh 32-hex trace id (uuid4, no dashes) — traceparent-sized."""
+    return uuid.uuid4().hex
+
+
+def sanitize_trace_id(raw: str | None) -> str | None:
+    """Clamp an untrusted header value to the id charset; None if empty."""
+    if not raw:
+        return None
+    return _TRACE_RE.sub("", raw)[:_TRACE_MAX] or None
+
+
+def set_trace(rid: str | None, trace_id: str | None) -> None:
+    """Associate a request id with a trace id (LRU-bounded)."""
+    if not rid or not trace_id:
+        return
+    with _rid_trace_lock:
+        _rid_trace[rid] = trace_id
+        _rid_trace.move_to_end(rid)
+        while len(_rid_trace) > _RID_TRACE_CAP:
+            _rid_trace.popitem(last=False)
+
+
+def trace_of(rid: str | None) -> str | None:
+    """The trace id associated with ``rid`` (or None)."""
+    if not rid:
+        return None
+    with _rid_trace_lock:
+        return _rid_trace.get(rid)
 
 
 def parse_buffer_env(var: str, default: int, legacy: str | None = None) -> int:
@@ -69,19 +132,24 @@ class Tracer:
     def __init__(self, capacity: int | None = None):
         self._lock = threading.Lock()
         self._spans = deque(maxlen=capacity or _capacity())
+        self._seq = 0
 
     def record(self, name: str, t0: float, t1: float, rid=None,
                **args) -> None:
         """Record a completed span; ``t0``/``t1`` are perf_counter secs.
         ``rid`` overrides the ambient contextvar request ID — threads that
         work on behalf of another request (the scheduler loop) stamp the
-        ticket's ID explicitly."""
+        ticket's ID explicitly.  The span's fleet trace id resolves from
+        the rid→trace map first, then the ambient contextvar."""
         th = threading.current_thread()
+        rid = rid if rid is not None else request_id_var.get()
+        trace = trace_of(rid) or trace_id_var.get()
         span = {"name": name, "ts": t0, "dur": max(t1 - t0, 0.0),
                 "tid": th.ident or 0, "thread": th.name,
-                "rid": rid if rid is not None else request_id_var.get(),
-                "args": args}
+                "rid": rid, "trace": trace, "args": args}
         with self._lock:
+            self._seq += 1
+            span["seq"] = self._seq
             self._spans.append(span)
 
     def resize(self, capacity: int) -> None:
@@ -108,6 +176,20 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+
+    def raw(self, since: int | None = None) -> dict:
+        """Machine-oriented export for incremental polling and fleet
+        stitching: spans with their ring sequence numbers (only those
+        after ``since`` when given), the cursor to pass next time, and a
+        paired ``(perf_now, wall_now)`` clock sample so a cross-process
+        consumer can map perf_counter timestamps to wall-clock."""
+        with self._lock:
+            spans = [dict(s) for s in self._spans
+                     if since is None or s.get("seq", 0) > since]
+            next_seq = self._seq
+        return {"spans": spans, "next_seq": next_seq,
+                "capacity": self.capacity,
+                "perf_now": time.perf_counter(), "wall_now": time.time()}
 
     def trace_events(self, last_requests: int | None = None) -> list[dict]:
         """Chrome ``trace_event`` array; optionally only the spans of the
@@ -137,6 +219,8 @@ class Tracer:
             args = dict(s["args"])
             if s["rid"]:
                 args["request_id"] = s["rid"]
+            if s.get("trace"):
+                args["trace_id"] = s["trace"]
             events.append({"name": s["name"], "cat": "dllama", "ph": "X",
                            "ts": round(s["ts"] * 1e6, 3),
                            "dur": round(s["dur"] * 1e6, 3),
@@ -168,6 +252,10 @@ def span(name: str, **args):
 
 def trace_json(last_requests: int | None = None) -> dict:
     return TRACER.trace_json(last_requests)
+
+
+def raw(since: int | None = None) -> dict:
+    return TRACER.raw(since)
 
 
 def clear() -> None:

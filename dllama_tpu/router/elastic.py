@@ -39,7 +39,7 @@ import collections
 import threading
 import time
 
-from ..obs import metrics as obs_metrics
+from ..obs import events as obs_events, metrics as obs_metrics
 from ..obs.log import get_logger
 
 _log = get_logger("router.elastic")
@@ -380,6 +380,9 @@ class ElasticController:
             self.registry.remove(f"127.0.0.1:{rep.port}")
             self.pool.release(rep.ordinals)
             obs_metrics.POD_SCALE_EVENTS.inc("down", "quarantined")
+            obs_events.emit("scale", direction="down",
+                            reason="quarantined",
+                            replica=f"127.0.0.1:{rep.port}", idx=rep.idx)
             obs_metrics.POD_REPLICAS_DESIRED.set(
                 len(self.ops.live_replicas()))
             _log.warning("elastic_reaped_quarantined", extra={
@@ -413,6 +416,8 @@ class ElasticController:
         addr = f"127.0.0.1:{rep.port}"
         self.registry.add(addr)
         obs_metrics.POD_SCALE_EVENTS.inc("up", reason)
+        obs_events.emit("scale", direction="up", reason=reason,
+                        replica=addr, idx=rep.idx, tp=tp)
         _log.info("elastic_scale_up", extra={
             "replica": rep.idx, "port": rep.port, "tp": tp,
             "devices": ordinals, "reason": reason})
@@ -465,6 +470,8 @@ class ElasticController:
         self.registry.remove(addr)
         self.pool.release(victim.ordinals)
         obs_metrics.POD_SCALE_EVENTS.inc("down", reason)
+        obs_events.emit("scale", direction="down", reason=reason,
+                        replica=addr, idx=victim.idx, tp=victim.tp)
         _log.info("elastic_scale_down", extra={
             "replica": victim.idx, "port": victim.port, "reason": reason})
         return True
@@ -503,6 +510,8 @@ class ElasticController:
         _log.info("elastic_reshape_start", extra={
             "tp_from": self.tp, "tp_to": tp_new, "target": target,
             "reason": reason})
+        obs_events.emit("reshape", phase="start", tp_from=self.tp,
+                        tp_to=tp_new, target=target, reason=reason)
         self.tp = tp_new
         obs_metrics.POD_REPLICAS_DESIRED.set(target)
         # generous overall bound: a wedged drain cannot wedge the
@@ -529,6 +538,10 @@ class ElasticController:
                 break
         obs_metrics.POD_RESHAPE_SECONDS.observe(time.monotonic() - t0)
         obs_metrics.POD_SCALE_EVENTS.inc("reshape", reason)
+        obs_events.emit("reshape", phase="done", tp_to=tp_new,
+                        reason=reason,
+                        seconds=round(time.monotonic() - t0, 3),
+                        replicas=len(self.ops.live_replicas()))
         _log.info("elastic_reshape_done", extra={
             "tp": tp_new, "seconds": round(time.monotonic() - t0, 3),
             "replicas": len(self.ops.live_replicas())})

@@ -49,7 +49,7 @@ import sys
 import threading
 import time
 
-from ..obs import metrics as obs_metrics
+from ..obs import events as obs_events, metrics as obs_metrics
 from ..obs.log import get_logger
 from ..runtime.faults import FAULTS
 
@@ -252,6 +252,8 @@ class Supervisor:
         rep.hang_streak = 0
         _log.info("pod_replica_spawned", extra={
             "replica": rep.idx, "port": rep.port, "pid": rep.proc.pid})
+        obs_events.emit("spawn", replica=f"127.0.0.1:{rep.port}",
+                        idx=rep.idx, pid=rep.proc.pid, tp=rep.tp)
 
     # -- runtime membership (elastic pod) -------------------------------
     def add(self, rep: _Replica) -> None:
@@ -361,6 +363,11 @@ class Supervisor:
             "replica": rep.idx, "reason": reason,
             "returncode": rep.proc.returncode if rep.proc else None,
             "deaths_in_window": len(rep.deaths)})
+        obs_events.emit("death", replica=f"127.0.0.1:{rep.port}",
+                        idx=rep.idx, reason=reason,
+                        returncode=rep.proc.returncode if rep.proc
+                        else None,
+                        deaths_in_window=len(rep.deaths))
         if len(rep.deaths) > self.respawn_max:
             rep.quarantined = True
             rep.proc = None
@@ -368,6 +375,10 @@ class Supervisor:
                 "replica": rep.idx, "reason": reason,
                 "deaths": len(rep.deaths),
                 "window_s": self.respawn_window})
+            obs_events.emit("quarantine", replica=f"127.0.0.1:{rep.port}",
+                            idx=rep.idx, reason=reason,
+                            deaths=len(rep.deaths),
+                            window_s=self.respawn_window)
             return
         try:
             FAULTS.fire("pod.respawn")
@@ -378,6 +389,9 @@ class Supervisor:
             rep.proc = None
             return
         obs_metrics.POD_RESPAWNS.inc(str(rep.idx), reason)
+        obs_events.emit("respawn", replica=f"127.0.0.1:{rep.port}",
+                        idx=rep.idx, reason=reason,
+                        pid=rep.proc.pid if rep.proc else None)
 
 
 class _PodOps:
@@ -510,7 +524,11 @@ def supervise_main(args) -> None:
             upstream_timeout=args.upstream_timeout,
             stall_timeout=getattr(args, "stall_timeout", 0.0),
             checkpoint_interval=getattr(args, "checkpoint_interval", 0.0),
-            resume_policy=getattr(args, "resume_policy", "auto"))
+            resume_policy=getattr(args, "resume_policy", "auto"),
+            # the replicas sit on loopback ephemeral ports: the pod's
+            # public /metrics defaults to the federated fleet scope so
+            # one external scrape sees every replica's families
+            fleet_scope_default=True)
         if elastic_on:
             policy = ElasticPolicy(
                 window=getattr(args, "elastic_window", 5),
@@ -667,7 +685,8 @@ def main(args) -> None:
             upstream_timeout=args.upstream_timeout,
             stall_timeout=getattr(args, "stall_timeout", 0.0),
             checkpoint_interval=getattr(args, "checkpoint_interval", 0.0),
-            resume_policy=getattr(args, "resume_policy", "auto"))
+            resume_policy=getattr(args, "resume_policy", "auto"),
+            fleet_scope_default=True)
         print(f"💡 serve-pod: {dp} replica(s) × tp={tp} over "
               f"{dp * tp}/{len(devices)} devices; router on :{args.port}")
         router_serve(rstate, host=args.host, port=args.port)

@@ -31,7 +31,7 @@ import json
 import threading
 import time
 
-from ..obs import metrics as obs_metrics
+from ..obs import events as obs_events, metrics as obs_metrics
 from ..obs.log import get_logger
 
 _log = get_logger("router.registry")
@@ -143,6 +143,8 @@ class Registry:
             if b.ejected and b.ok_streak >= self.readmit_after:
                 b.ejected = False
                 obs_metrics.ROUTER_READMITS.inc(b.addr)
+                obs_events.emit("readmit", replica=b.addr,
+                                ok_streak=b.ok_streak)
                 _log.info("backend %s re-admitted after %d healthy probes",
                           b.addr, b.ok_streak)
         return True
@@ -207,6 +209,7 @@ class Registry:
             for b in self.backends:
                 if b.addr == addr:
                     b.retiring = True
+                    obs_events.emit("retire", replica=addr)
                     return
 
     def get(self, addr: str) -> Backend | None:
@@ -228,6 +231,8 @@ class Registry:
         if not b.ejected and b.fail_streak >= self.eject_after:
             b.ejected = True
             obs_metrics.ROUTER_EJECTIONS.inc(b.addr)
+            obs_events.emit("eject", replica=b.addr, why=why,
+                            fail_streak=b.fail_streak)
             _log.warning("backend %s EJECTED after %d consecutive %s "
                          "failures", b.addr, b.fail_streak, why)
 
@@ -248,6 +253,8 @@ class Registry:
             if not b.ejected:
                 b.ejected = True
                 obs_metrics.ROUTER_EJECTIONS.inc(b.addr)
+                obs_events.emit("eject", replica=b.addr, why=why,
+                                forced=True)
                 _log.warning("backend %s EJECTED (%s)", b.addr, why)
 
     def record_success(self, b: Backend) -> None:

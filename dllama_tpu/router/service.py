@@ -49,10 +49,12 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs
 
-from ..obs import flight as obs_flight, metrics as obs_metrics
+from ..obs import events as obs_events, flight as obs_flight, \
+    metrics as obs_metrics, trace as obs_trace
 from ..obs.log import get_logger, set_request_id
 from ..runtime.snapshot import RecordStore
 from ..server.backoff import jittered_retry_after
+from .fleet import FleetScraper
 from .registry import Backend, Registry
 
 _log = get_logger("router.service")
@@ -94,7 +96,8 @@ class RouterState:
                  stall_timeout: float = 0.0,
                  checkpoint_interval: float = 0.0,
                  resume_policy: str = "auto",
-                 resume_window: float = 10.0):
+                 resume_window: float = 10.0,
+                 fleet_scope_default: bool = False):
         self.registry = registry
         self.retries = max(0, int(retries))
         self.upstream_timeout = float(upstream_timeout)
@@ -129,6 +132,13 @@ class RouterState:
         # --elastic: surfaces the fleet block in /health and accepts
         # /admin/scale + /admin/reshape commands
         self.elastic = None
+        # fleet federation (router/fleet.py): /metrics?scope=fleet
+        # scrapes every registered replica and re-exposes everything
+        # with a replica label; serve-pod makes fleet the default scope
+        # (its replicas sit on loopback ephemeral ports — the pod's
+        # public port is the only scrapeable surface)
+        self.fleet = FleetScraper(registry)
+        self.fleet_scope_default = bool(fleet_scope_default)
 
     def connect(self, b: Backend) -> http.client.HTTPConnection:
         return http.client.HTTPConnection(b.host, b.port,
@@ -195,6 +205,8 @@ def make_handler(state: RouterState):
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
             self.send_header("X-Request-Id", getattr(self, "_rid", "") or "")
+            if getattr(self, "_trace", None):
+                self.send_header("X-Dllama-Trace", self._trace)
             for k, v in headers:
                 self.send_header(k, v)
             self.end_headers()
@@ -210,6 +222,8 @@ def make_handler(state: RouterState):
                              ctype or "application/octet-stream")
             self.send_header("Content-Length", str(len(data)))
             self.send_header("X-Request-Id", getattr(self, "_rid", "") or "")
+            if getattr(self, "_trace", None):
+                self.send_header("X-Dllama-Trace", self._trace)
             for k, v in headers:
                 if v:
                     self.send_header(k, v)
@@ -227,6 +241,8 @@ def make_handler(state: RouterState):
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Connection", "close")
             self.send_header("X-Request-Id", self._rid)
+            if getattr(self, "_trace", None):
+                self.send_header("X-Dllama-Trace", self._trace)
             self.end_headers()
             ctx.headers_sent = True
 
@@ -283,9 +299,19 @@ def make_handler(state: RouterState):
             elif path == "/metrics":
                 q = parse_qs(query)
                 accept = self.headers.get("Accept") or ""
-                if (q.get("format", [""])[0] == "prometheus"
-                        or "text/plain" in accept or "openmetrics" in accept):
-                    data = obs_metrics.render_prometheus().encode()
+                prom = (q.get("format", [""])[0] == "prometheus"
+                        or "text/plain" in accept or "openmetrics" in accept)
+                scope = q.get("scope", [""])[0] or (
+                    "fleet" if state.fleet_scope_default else "self")
+                if scope not in ("fleet", "self"):
+                    self._json(400, {"error": f"unknown scope {scope!r}; "
+                                              "expected fleet|self"})
+                    return
+                if prom:
+                    text = state.fleet.federated_prometheus() \
+                        if scope == "fleet" \
+                        else obs_metrics.render_prometheus()
+                    data = text.encode()
                     self.send_response(200)
                     self.send_header(
                         "Content-Type",
@@ -293,8 +319,50 @@ def make_handler(state: RouterState):
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
                     self.wfile.write(data)
+                elif scope == "fleet":
+                    self._json(200, state.fleet.federated_json())
                 else:
                     self._json(200, obs_metrics.snapshot_json())
+            elif path == "/debug/trace":
+                # scope=fleet stitches every replica's span ring (plus
+                # the router's own) into one wall-clock-aligned Perfetto
+                # timeline with journal markers; ?trace=<id> narrows to
+                # one request's fleet-wide story.  Default scope is the
+                # router's own ring, same contract as a replica's.
+                qs = parse_qs(query)
+                scope = qs.get("scope", [""])[0]
+                if scope == "fleet":
+                    self._json(200, state.fleet.fleet_trace(
+                        trace=qs.get("trace", [None])[0]))
+                    return
+                if "since" in qs:
+                    try:
+                        since = int(qs["since"][0])
+                    except ValueError:
+                        since = 0
+                    self._json(200, obs_trace.raw(since))
+                    return
+                try:
+                    last = int(qs["last"][0]) if "last" in qs else 20
+                except ValueError:
+                    last = 20
+                self._json(200, obs_trace.trace_json(last))
+            elif path == "/debug/events":
+                # the pod event journal (spawn/death/respawn/quarantine/
+                # eject/readmit/scale/reshape live here in the router
+                # process); ?since=<seq> tails incrementally,
+                # ?scope=fleet folds in every replica's journal too
+                qs = parse_qs(query)
+                since = None
+                if "since" in qs:
+                    try:
+                        since = int(qs["since"][0])
+                    except ValueError:
+                        since = 0
+                if qs.get("scope", [""])[0] == "fleet":
+                    self._json(200, state.fleet.fleet_events(since))
+                else:
+                    self._json(200, obs_events.snapshot(since))
             elif path == "/debug/requests":
                 try:
                     n = int(q[0]) if (q := parse_qs(query).get("n")) else 50
@@ -395,6 +463,15 @@ def make_handler(state: RouterState):
                 "", self.headers.get("X-Request-Id") or "")[:_RID_MAX] \
                 or uuid.uuid4().hex[:16]
             set_request_id(self._rid)
+            # fleet trace context: adopt the client's X-Dllama-Trace or
+            # mint one here at the fleet edge.  Propagated on every
+            # upstream hop and carried inside DLREQ01 records, so a
+            # request that is handed off / resumed between replicas is
+            # ONE trace id across every process's span ring.
+            self._trace = obs_trace.sanitize_trace_id(
+                self.headers.get("X-Dllama-Trace")) \
+                or obs_trace.new_trace_id()
+            obs_trace.set_trace(self._rid, self._trace)
             # QoS class rides alongside X-Request-Id: body field wins
             # over the header; unknown values degrade to None (the
             # replica applies its own default/validation)
@@ -550,6 +627,10 @@ def make_handler(state: RouterState):
                         obs_metrics.ROUTER_RESUMES.inc("checkpoint")
                         obs_flight.retire(rid, reason="resumed",
                                           backend=peer.addr)
+                        obs_events.emit(
+                            "resume", rid=rid, tier="checkpoint",
+                            src=dead.addr, dst=peer.addr,
+                            trace=getattr(self, "_trace", None))
                         return
                     # the continuation died too — fall through to the
                     # re-run tier; ctx.text still covers every char the
@@ -559,6 +640,9 @@ def make_handler(state: RouterState):
             if verdict == "done":
                 obs_metrics.ROUTER_RESUMES.inc("rerun")
                 obs_flight.retire(rid, reason="resumed")
+                obs_events.emit("resume", rid=rid, tier="rerun",
+                                src=dead.addr,
+                                trace=getattr(self, "_trace", None))
                 return
             obs_metrics.ROUTER_RESUMES.inc(verdict)
             self._finish_replica_lost(ctx, chat, rid)
@@ -624,6 +708,8 @@ def make_handler(state: RouterState):
                     headers = {"Content-Type": "application/json",
                                "X-Request-Id": rid,
                                "X-Dllama-Hop": state.hop}
+                    if getattr(self, "_trace", None):
+                        headers["X-Dllama-Trace"] = self._trace
                     if getattr(self, "_prio", None):
                         headers["X-Dllama-Priority"] = self._prio
                     conn.request("POST", path, raw, headers=headers)
@@ -717,6 +803,8 @@ def make_handler(state: RouterState):
                     headers = {"Content-Type": "application/json",
                                "X-Request-Id": rid,
                                "X-Dllama-Hop": state.hop}
+                    if getattr(self, "_trace", None):
+                        headers["X-Dllama-Trace"] = self._trace
                     if getattr(self, "_prio", None):
                         headers["X-Dllama-Priority"] = self._prio
                     conn.request("POST", path, raw, headers=headers)
@@ -937,6 +1025,9 @@ def make_handler(state: RouterState):
             peer, resp, conn = got
             obs_metrics.ROUTER_HANDOFFS.inc()
             obs_flight.phase(rid, "handoff_resume", backend=peer.addr)
+            obs_events.emit("handoff", rid=rid, src=b.addr, dst=peer.addr,
+                            chars=ctx.chars,
+                            trace=getattr(self, "_trace", None))
             try:
                 return self._relay_continuation(peer, resp, chat, rid,
                                                 ctx)
@@ -1003,6 +1094,9 @@ def make_handler(state: RouterState):
             peer, resp, conn = got
             obs_metrics.ROUTER_HANDOFFS.inc()
             obs_flight.phase(rid, "handoff_resume", backend=peer.addr)
+            obs_events.emit("handoff", rid=rid, src=b.addr, dst=peer.addr,
+                            chars=emitted_chars,
+                            trace=getattr(self, "_trace", None))
             parts: list[str] = []
             finish = None
             completion_tokens = None

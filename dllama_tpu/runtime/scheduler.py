@@ -79,7 +79,7 @@ from collections import deque
 
 import numpy as np
 
-from ..obs import flight as obs_flight
+from ..obs import events as obs_events, flight as obs_flight
 from ..obs import metrics as obs_metrics, trace as obs_trace
 from ..obs.log import get_logger, new_request_id, request_id_var
 from .faults import FAULTS
@@ -632,6 +632,7 @@ class SlotScheduler:
                 "fed": s.fed, "produced": s.produced, "last": s.last,
                 "priority": t.priority, "preempt_count": t.preempt_count,
                 "parked_ms": t.parked_ms, "spill_ms": t.spill_ms,
+                "trace_id": obs_trace.trace_of(t.rid),
             })
 
     def handoff_export_all(self) -> dict[str, bytes]:
@@ -681,6 +682,9 @@ class SlotScheduler:
             self._cond.notify_all()
         if records:
             _log.info("handoff export", extra={"requests": len(records)})
+            for rid in records:
+                obs_events.emit("handoff", direction="export", rid=rid,
+                                trace=obs_trace.trace_of(rid))
         return records
 
     def checkpoint_export(self, rid: str) -> bytes | None:
@@ -816,6 +820,12 @@ class SlotScheduler:
                        tuple(int(e) for e in extra.get("eos_ids") or ()),
                        deadline)
             t.rid = str(extra.get("rid") or t.rid)
+            # re-establish the fleet trace context on the importing
+            # replica: every span this scheduler records for the resumed
+            # request (rid-stamped) joins the exporter's trace id, so a
+            # migrated request is ONE trace across both rings
+            if extra.get("trace_id"):
+                obs_trace.set_trace(t.rid, str(extra["trace_id"]))
             t.stop = [str(x) for x in extra.get("stop") or []]
             t.emitted = list(completion)
             t.priority = int(extra.get("priority", 1))
@@ -857,6 +867,9 @@ class SlotScheduler:
                 "pages": len(pages)})
         finally:
             request_id_var.reset(ctx)
+        obs_events.emit("handoff", direction="import", rid=t.rid,
+                        slot=slot_idx, pos=pos, produced=produced,
+                        trace=obs_trace.trace_of(t.rid))
         return t, extra
 
     # -- scheduler thread ----------------------------------------------
@@ -1184,6 +1197,9 @@ class SlotScheduler:
         obs_flight.phase(t.rid, "preempted", slot=slot_idx, reason=reason,
                          produced=s.produced,
                          preempt_count=t.preempt_count)
+        obs_events.emit("preempt", rid=t.rid, slot=slot_idx, reason=reason,
+                        produced=s.produced, spilled=path is not None,
+                        trace=obs_trace.trace_of(t.rid))
 
     def _unpark_locked(self, slot_idx: int, entry: _Parked,
                        now: float) -> bool:
@@ -1270,6 +1286,9 @@ class SlotScheduler:
             request_id_var.reset(ctx)
         obs_flight.phase(t.rid, "resumed", slot=slot_idx,
                          parked_ms=parked_ms, pos=pos)
+        obs_events.emit("resume", rid=t.rid, slot=slot_idx,
+                        parked_ms=parked_ms, pos=pos,
+                        trace=obs_trace.trace_of(t.rid))
         return True
 
     def _drop_parked_locked(self, entry: _Parked) -> None:

@@ -71,8 +71,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs
 
 from ..io.integrity import ArtifactError
-from ..obs import dispatch as obs_dispatch, flight as obs_flight, \
-    metrics as obs_metrics, trace as obs_trace
+from ..obs import dispatch as obs_dispatch, events as obs_events, \
+    flight as obs_flight, metrics as obs_metrics, trace as obs_trace
 from ..obs.log import (configure as configure_logging, get_logger,
                        new_request_id, set_request_id)
 from ..runtime.engine import ContextOverflow, Engine, NumericFault, StepTimeout
@@ -1362,6 +1362,18 @@ def make_handler(state: ApiState):
             # back to the router-side ring (fleet correlation satellite)
             hop = self.headers.get("X-Dllama-Hop") or ""
             self._hop = _RID_RE.sub("", hop)[:_RID_MAX] or None
+            # fleet trace context (X-Dllama-Trace): the router stamps
+            # one id at accept and propagates it on every hop; binding
+            # it to the rid here means scheduler-loop spans (recorded
+            # with rid=t.rid) resolve to the same trace without any
+            # call-site change, and DLREQ01 exports can carry it to the
+            # replica that resumes the request.
+            trace = obs_trace.sanitize_trace_id(
+                self.headers.get("X-Dllama-Trace"))
+            self._trace = trace
+            obs_trace.trace_id_var.set(trace)
+            if trace:
+                obs_trace.set_trace(rid, trace)
             # QoS class from the transport header; the body field (when
             # present) overrides it in do_POST.  An unknown header value
             # is ignored — the router relays client headers verbatim and
@@ -1375,6 +1387,9 @@ def make_handler(state: ApiState):
             rid = getattr(self, "_rid", None)
             if rid:
                 self.send_header("X-Request-Id", rid)
+            trace = getattr(self, "_trace", None)
+            if trace:
+                self.send_header("X-Dllama-Trace", trace)
 
         def _json(self, code: int, obj: dict, headers: dict | None = None):
             data = json.dumps(obj).encode()
@@ -1647,13 +1662,37 @@ def make_handler(state: ApiState):
                     self._json(200, merged)
             elif path == "/debug/trace":
                 # Chrome trace_event JSON for the last N requests' spans
-                # (obs/trace.py ring buffer; tools/trace_dump.py wraps this)
+                # (obs/trace.py ring buffer; tools/trace_dump.py wraps
+                # this).  ?since=<seq> switches to the raw incremental
+                # export — sequenced spans plus a perf/wall clock sample
+                # — which the router's fleet stitcher and fleet_top poll
+                # instead of re-downloading the whole ring every tick.
+                qs = parse_qs(query)
+                if "since" in qs:
+                    try:
+                        since = int(qs["since"][0])
+                    except ValueError:
+                        since = 0
+                    self._json(200, obs_trace.raw(since))
+                    return
                 try:
-                    last = int(q[0]) if (q := parse_qs(query).get("last")) \
-                        else 20
+                    last = int(q[0]) if (q := qs.get("last")) else 20
                 except ValueError:
                     last = 20
                 self._json(200, obs_trace.trace_json(last))
+            elif path == "/debug/events":
+                # the pod event journal (obs/events.py): this replica's
+                # own lifecycle events (preempt/resume/handoff); the
+                # router/pod process serves its fleet-level journal at
+                # the same path.  ?since=<seq> tails incrementally.
+                qs = parse_qs(query)
+                since = None
+                if "since" in qs:
+                    try:
+                        since = int(qs["since"][0])
+                    except ValueError:
+                        since = 0
+                self._json(200, obs_events.snapshot(since))
             elif path == "/debug/requests":
                 # flight recorder (obs/flight.py): newest-first summaries
                 try:
@@ -2564,6 +2603,8 @@ def main(argv=None):
     configure_logging(args.log_format, args.log_level)
     obs_trace.configure(args.trace_buffer)
     obs_flight.configure(args.flight_buffer)
+    obs_events.configure(getattr(args, "event_buffer", None),
+                         getattr(args, "event_log", None))
     slo = None
     slo_spec = args.slo or os.environ.get("DLLAMA_SLO", "")
     if slo_spec:

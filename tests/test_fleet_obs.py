@@ -1,0 +1,716 @@
+"""Fleet observability tests: metrics federation, cross-replica trace
+stitching, and the pod event journal (docs/OBSERVABILITY.md "Fleet
+observability").
+
+The acceptance contracts pinned here:
+
+* **event journal** (obs/events.py) — bounded monotonically-sequenced
+  ring, ``?since=`` cursor semantics, optional JSONL persistence, and
+  the ``dllama_pod_events_total`` counter riding every emit;
+* **trace context** (obs/trace.py) — ``X-Dllama-Trace`` ids sanitize
+  like request ids, attach to spans via the rid→trace map or the
+  ambient contextvar, and export through ``raw()`` with the paired
+  ``(perf_now, wall_now)`` clock sample federation needs;
+* **federation** (router/fleet.py) — one scrape of the router/pod
+  returns every replica's families under a ``replica`` label in both
+  expositions, failures marked (``fleet_replica_up 0`` + stale JSON)
+  and never silently dropped, pre-existing ``replica`` labels renamed
+  ``exported_replica`` instead of duplicated;
+* **trace stitching** — spans from two replica processes land on one
+  wall-clock-aligned Perfetto timeline under one trace id, with event-
+  journal instant markers laid over them;
+* **DLREQ01 carriage** — a hand-off export/import and a preempt-park-
+  resume both keep the request's trace id end to end, narrated by
+  ``handoff``/``preempt``/``resume`` journal events;
+* **tools** — ``fleet_top --once`` renders the per-replica table and
+  event tail; ``trace_dump --fleet`` writes the stitched file and
+  reports which traces crossed replicas.
+"""
+
+import http.server
+import importlib.util
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dllama_tpu.obs import events as obs_events, metrics as obs_metrics, \
+    trace as obs_trace
+from dllama_tpu.obs.events import EventJournal
+from dllama_tpu.router.fleet import (FleetScraper, merge_prometheus,
+                                     parse_prometheus)
+from dllama_tpu.router.registry import Registry
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --- unit: event journal --------------------------------------------------
+
+def test_event_journal_seq_cursor_and_ring_bound():
+    j = EventJournal(capacity=4)
+    for i in range(6):
+        ev = j.emit("spawn", replica=f"r{i}", skipped=None)
+        assert "skipped" not in ev          # None fields dropped
+        assert ev["seq"] == i + 1
+    snap = j.snapshot()
+    assert [e["seq"] for e in snap["events"]] == [3, 4, 5, 6]
+    assert snap["next_seq"] == 6 and snap["oldest_seq"] == 3
+    assert snap["capacity"] == 4
+    # cursor: only events after `since`, and an up-to-date cursor is empty
+    assert [e["seq"] for e in j.snapshot(4)["events"]] == [5, 6]
+    assert j.snapshot(6)["events"] == []
+    # ts is wall-clock, ordered with seq
+    evs = snap["events"]
+    assert all(abs(e["ts"] - time.time()) < 60 for e in evs)
+
+
+def test_event_journal_jsonl_persistence_and_counter(tmp_path):
+    before = (obs_metrics.snapshot_json().get("pod_events") or {})
+    j = EventJournal(capacity=8)
+    log = tmp_path / "events.jsonl"
+    j.set_log_path(str(log))
+    j.emit("death", replica="127.0.0.1:1", reason="sigkill")
+    j.emit("respawn", replica="127.0.0.1:1", pid=42)
+    j.set_log_path(None)
+    lines = [json.loads(x) for x in log.read_text().splitlines()]
+    assert [e["kind"] for e in lines] == ["death", "respawn"]
+    assert lines[0]["reason"] == "sigkill" and lines[1]["pid"] == 42
+    # append mode: a restart extends the file
+    j2 = EventJournal(capacity=8)
+    j2.set_log_path(str(log))
+    j2.emit("readmit", replica="127.0.0.1:1")
+    j2.set_log_path(None)
+    assert len(log.read_text().splitlines()) == 3
+    after = (obs_metrics.snapshot_json().get("pod_events") or {})
+    for kind in ("death", "respawn", "readmit"):
+        assert after.get(kind, 0) >= before.get(kind, 0) + 1
+
+
+def test_event_journal_module_globals():
+    base = obs_events.snapshot()["next_seq"]
+    obs_events.emit("scale", direction="up", reason="test")
+    snap = obs_events.snapshot(base)
+    assert len(snap["events"]) == 1
+    assert snap["events"][0]["kind"] == "scale"
+    assert "scale" in obs_events.KINDS
+
+
+# --- unit: trace context --------------------------------------------------
+
+def test_trace_id_sanitize_and_rid_map():
+    assert obs_trace.sanitize_trace_id(None) is None
+    assert obs_trace.sanitize_trace_id("") is None
+    assert obs_trace.sanitize_trace_id("<script>!!") == "script"
+    assert obs_trace.sanitize_trace_id("ab" * 100) == "ab" * 32
+    tid = obs_trace.new_trace_id()
+    assert len(tid) == 32 and obs_trace.sanitize_trace_id(tid) == tid
+    obs_trace.set_trace("rid-x", tid)
+    assert obs_trace.trace_of("rid-x") == tid
+    assert obs_trace.trace_of("rid-unknown") is None
+    assert obs_trace.trace_of(None) is None
+
+
+def test_tracer_raw_cursor_clock_sample_and_span_trace():
+    obs_trace.clear()
+    tid = obs_trace.new_trace_id()
+    obs_trace.set_trace("rid-a", tid)
+    t0 = time.perf_counter()
+    obs_trace.record("one", t0, t0 + 0.01, rid="rid-a")
+    obs_trace.record("two", t0 + 0.02, t0 + 0.03, rid="rid-nomap")
+    dump = obs_trace.raw()
+    spans = {s["name"]: s for s in dump["spans"]}
+    assert spans["one"]["trace"] == tid          # via rid→trace map
+    assert spans["two"]["trace"] is None
+    # the paired clock sample that federation aligns timelines with
+    assert abs(dump["perf_now"] - time.perf_counter()) < 5.0
+    assert abs(dump["wall_now"] - time.time()) < 5.0
+    # since-cursor: only newer spans
+    cur = dump["next_seq"]
+    obs_trace.record("three", t0 + 0.04, t0 + 0.05, rid="rid-a")
+    inc = obs_trace.raw(cur)
+    assert [s["name"] for s in inc["spans"]] == ["three"]
+    assert obs_trace.raw(inc["next_seq"])["spans"] == []
+    # ambient contextvar fallback when rid has no mapping
+    tok = obs_trace.trace_id_var.set("ambient1")
+    try:
+        obs_trace.record("four", t0, t0 + 0.01, rid="rid-ambient")
+    finally:
+        obs_trace.trace_id_var.reset(tok)
+    four = [s for s in obs_trace.raw()["spans"] if s["name"] == "four"][0]
+    assert four["trace"] == "ambient1"
+    # the Chrome export surfaces the id for Perfetto queries
+    ev = [e for e in obs_trace.trace_json()["traceEvents"]
+          if e.get("ph") == "X" and e["name"] == "three"][0]
+    assert ev["args"]["trace_id"] == tid
+    obs_trace.clear()
+
+
+# --- unit: prometheus federation merge ------------------------------------
+
+REPLICA_TEXT = """\
+# HELP dllama_requests_served_total Requests completed successfully.
+# TYPE dllama_requests_served_total counter
+dllama_requests_served_total 7
+# HELP dllama_ttft_seconds TTFT.
+# TYPE dllama_ttft_seconds histogram
+dllama_ttft_seconds_bucket{le="0.1"} 3
+dllama_ttft_seconds_bucket{le="+Inf"} 7
+dllama_ttft_seconds_sum 1.5
+dllama_ttft_seconds_count 7
+"""
+
+ROUTER_TEXT = """\
+# HELP dllama_fleet_replica_up Reachability.
+# TYPE dllama_fleet_replica_up gauge
+dllama_fleet_replica_up{replica="127.0.0.1:9"} 1
+dllama_requests_served_total 1
+"""
+
+
+def test_parse_prometheus_families_and_orphans():
+    fams = parse_prometheus(REPLICA_TEXT)
+    assert fams["dllama_requests_served_total"]["type"] == "counter"
+    hist = fams["dllama_ttft_seconds"]
+    assert len(hist["samples"]) == 4     # buckets/sum/count own family
+    orphan = parse_prometheus("lonely_metric 3\n")["lonely_metric"]
+    assert orphan["type"] is None and orphan["samples"]
+
+
+def test_merge_prometheus_injects_and_renames_replica_label():
+    text = merge_prometheus([("router", ROUTER_TEXT),
+                             ("127.0.0.1:1234", REPLICA_TEXT)])
+    assert 'dllama_requests_served_total{replica="127.0.0.1:1234"} 7' \
+        in text
+    assert 'dllama_ttft_seconds_bucket{replica="127.0.0.1:1234",' \
+           'le="0.1"} 3' in text
+    # the router's own replica-labeled family federates as
+    # exported_replica — never a duplicated label (invalid exposition)
+    assert 'dllama_fleet_replica_up{replica="router",' \
+           'exported_replica="127.0.0.1:9"} 1' in text
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            assert line.count('replica="') - \
+                line.count('exported_replica="') == 1, line
+    # HELP/TYPE once per family even with two sources
+    assert text.count("# TYPE dllama_requests_served_total") == 1
+
+
+# --- integration: fake replicas behind a FleetScraper ---------------------
+
+class _Replica:
+    """In-thread HTTP server speaking the replica observability surface
+    from canned (settable) documents."""
+
+    def __init__(self, metrics_json=None, prom_text=None,
+                 trace_doc=None, events_doc=None):
+        self.metrics_json = metrics_json or {"requests_served": 1}
+        self.prom_text = prom_text or REPLICA_TEXT
+        self.trace_doc = trace_doc
+        self.events_doc = events_doc or {"events": [], "next_seq": 0,
+                                         "oldest_seq": 1, "capacity": 16}
+        outer = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path.startswith("/metrics"):
+                    if "prometheus" in self.path:
+                        body, ctype = outer.prom_text.encode(), "text/plain"
+                    else:
+                        body = json.dumps(outer.metrics_json).encode()
+                        ctype = "application/json"
+                elif self.path.startswith("/debug/trace"):
+                    body = json.dumps(outer.trace_doc or {}).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/debug/events"):
+                    body = json.dumps(outer.events_doc).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/health"):
+                    body, ctype = b"{}", "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def addr(self):
+        return f"127.0.0.1:{self.port}"
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_federated_metrics_marks_never_drops():
+    r1 = _Replica(metrics_json={"requests_served": 3})
+    r2 = _Replica(metrics_json={"requests_served": 5})
+    try:
+        reg = Registry([r1.addr, r2.addr])
+        fs = FleetScraper(reg, timeout=2.0)
+
+        # ONE scrape carries replica-labeled families from BOTH replicas
+        # plus the router's own, under distinct replica labels
+        text = fs.federated_prometheus()
+        for addr in (r1.addr, r2.addr):
+            assert f'dllama_requests_served_total{{replica="{addr}"}} ' \
+                in text
+            assert f'dllama_fleet_replica_up{{replica="router",' \
+                   f'exported_replica="{addr}"}} 1' in text
+
+        doc = fs.federated_json()
+        assert doc["scope"] == "fleet"
+        assert doc["replicas"][r1.addr]["up"] is True
+        assert doc["replicas"][r1.addr]["metrics"]["requests_served"] == 3
+        assert doc["replicas"][r2.addr]["metrics"]["requests_served"] == 5
+        assert "uptime_s" in doc["router"]
+
+        # kill one replica: marked down + stale last-good, never dropped
+        r2.close()
+        doc = fs.federated_json()
+        dead = doc["replicas"][r2.addr]
+        assert dead["up"] is False and dead["stale"] is True
+        assert dead["metrics"]["requests_served"] == 5
+        assert dead["stale_age_s"] >= 0
+        text = fs.federated_prometheus()
+        assert f'dllama_fleet_replica_up{{replica="router",' \
+               f'exported_replica="{r2.addr}"}} 0' in text
+        assert (obs_metrics.snapshot_json()["fleet_scrape_errors"]
+                .get(r2.addr, 0)) >= 1
+    finally:
+        r1.close()
+        r2.close()
+
+
+def _trace_doc(spans):
+    """A replica ``raw()`` export whose perf clock is an arbitrary epoch
+    far from wall time — the stitcher must align on wall_now-perf_now."""
+    return {"spans": spans, "next_seq": len(spans), "capacity": 512,
+            "perf_now": 1000.0, "wall_now": time.time()}
+
+
+def _span(name, ts, rid, trace, seq, tid=7):
+    return {"name": name, "ts": ts, "dur": 0.01, "tid": tid,
+            "thread": "sched", "rid": rid, "trace": trace,
+            "args": {}, "seq": seq}
+
+
+def test_fleet_trace_stitches_one_trace_across_replicas():
+    tid = "feedbeef" * 4
+    # replica A served the first half, B resumed after a hand-off; their
+    # perf clocks are wildly different epochs
+    ra = _Replica(trace_doc=_trace_doc(
+        [_span("prefill", 990.0, "req-1", tid, 1),
+         _span("decode_chunk", 991.0, "req-1", tid, 2),
+         _span("other", 991.5, "req-9", "cafe" * 8, 3)]))
+    rb = _Replica(
+        trace_doc=_trace_doc(
+            [_span("decode_chunk", 995.0, "req-1", tid, 1)]),
+        events_doc={"events": [
+            {"kind": "respawn", "ts": time.time(), "seq": 1,
+             "replica": "x"},
+            {"kind": "handoff", "ts": time.time(), "seq": 2,
+             "rid": "req-1", "trace": tid}],
+            "next_seq": 2, "oldest_seq": 1, "capacity": 16})
+    try:
+        reg = Registry([ra.addr, rb.addr])
+        fs = FleetScraper(reg, timeout=2.0)
+        doc = fs.fleet_trace()
+        assert doc["displayTimeUnit"] == "ms"
+        assert doc["fleet"][ra.addr] == {"up": True, "spans": 3}
+        assert doc["fleet"][rb.addr] == {"up": True, "spans": 1}
+        assert doc["fleet"]["router"]["up"] is True
+
+        spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        mine = [e for e in spans
+                if e.get("args", {}).get("trace_id") == tid]
+        # one trace id, spans from BOTH replica processes (distinct pids)
+        assert len({e["pid"] for e in mine}) == 2
+        assert all(e["args"]["request_id"] == "req-1" for e in mine)
+        # wall-clock alignment: every shifted ts lands near now (µs)
+        now_us = time.time() * 1e6
+        for e in mine:
+            assert abs(e["ts"] - now_us) < 120e6, e
+        # journal instant markers ride the timeline
+        marks = {e["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "i"}
+        assert {"event:respawn", "event:handoff"} <= marks
+
+        # trace filter: other traces' spans gone, traceless journal
+        # markers (the fleet context) kept
+        doc = fs.fleet_trace(trace=tid)
+        spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert {e["args"]["trace_id"] for e in spans} == {tid}
+        marks = {e["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "i"}
+        assert "event:respawn" in marks
+    finally:
+        ra.close()
+        rb.close()
+
+
+def test_fleet_events_keyed_by_replica():
+    r1 = _Replica(events_doc={"events": [
+        {"kind": "spawn", "ts": 1.0, "seq": 1}],
+        "next_seq": 1, "oldest_seq": 1, "capacity": 16})
+    try:
+        reg = Registry([r1.addr, "127.0.0.1:1"])   # second one dead
+        fs = FleetScraper(reg, timeout=1.0)
+        doc = fs.fleet_events()
+        assert doc["replicas"][r1.addr]["events"][0]["kind"] == "spawn"
+        assert doc["replicas"]["127.0.0.1:1"] == {"up": False}
+        assert "next_seq" in doc["router"]
+    finally:
+        r1.close()
+
+
+# --- integration: the router's public endpoints ---------------------------
+
+@pytest.fixture
+def router_server():
+    """A real router handler over fake replicas — the surface
+    fleet_top/trace_dump/Prometheus actually scrape."""
+    from dllama_tpu.router.service import RouterState, make_handler
+
+    replicas, servers = [], []
+
+    def make(n=2, *, fleet_scope_default=False, **replica_kw):
+        for _ in range(n):
+            replicas.append(_Replica(**replica_kw))
+        reg = Registry([r.addr for r in replicas])
+        state = RouterState(reg,
+                            fleet_scope_default=fleet_scope_default)
+        srv = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), make_handler(state))
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        servers.append(srv)
+        return (state, replicas,
+                f"http://127.0.0.1:{srv.server_address[1]}")
+
+    yield make
+    for s in servers:
+        s.shutdown()
+        s.server_close()
+    for r in replicas:
+        r.close()
+
+
+def _get(base, path, headers=None):
+    req = urllib.request.Request(base + path, headers=headers or {})
+    return urllib.request.urlopen(req, timeout=30)
+
+
+def test_router_metrics_scope_negotiation(router_server):
+    state, replicas, base = router_server(2)
+    # default scope=self: no replica labels
+    with _get(base, "/metrics") as r:
+        doc = json.loads(r.read())
+    assert "replicas" not in doc and "uptime_s" in doc
+    # explicit fleet scope: both expositions federated
+    with _get(base, "/metrics?scope=fleet") as r:
+        doc = json.loads(r.read())
+    assert set(doc["replicas"]) == {x.addr for x in replicas}
+    with _get(base, "/metrics?scope=fleet&format=prometheus") as r:
+        assert "version=0.0.4" in r.headers["Content-Type"]
+        text = r.read().decode()
+    for x in replicas:
+        assert f'replica="{x.addr}"' in text
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(base, "/metrics?scope=banana")
+    assert ei.value.code == 400
+
+
+def test_router_fleet_scope_default_is_pod_mode(router_server):
+    state, replicas, base = router_server(1, fleet_scope_default=True)
+    # serve-pod mode: a bare public scrape IS the fleet scrape
+    with _get(base, "/metrics") as r:
+        doc = json.loads(r.read())
+    assert replicas[0].addr in doc["replicas"]
+    with _get(base, "/metrics?scope=self") as r:
+        doc = json.loads(r.read())
+    assert "replicas" not in doc
+
+
+def test_router_debug_trace_and_events_endpoints(router_server):
+    tid = "abcd" * 8
+    state, replicas, base = router_server(
+        1, trace_doc=_trace_doc([_span("decode_chunk", 1.0,
+                                       "req-2", tid, 1)]))
+    obs_trace.clear()
+    with _get(base, "/debug/trace?scope=fleet") as r:
+        doc = json.loads(r.read())
+    assert replicas[0].addr in doc["fleet"]
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert any(e["args"].get("trace_id") == tid for e in spans)
+    # replica-style raw cursor on the router's own ring
+    with _get(base, "/debug/trace?since=0") as r:
+        doc = json.loads(r.read())
+    assert "next_seq" in doc and "perf_now" in doc
+    # journal endpoint with cursor
+    cur = obs_events.snapshot()["next_seq"]
+    obs_events.emit("eject", replica="r-test", why="probe")
+    with _get(base, f"/debug/events?since={cur}") as r:
+        doc = json.loads(r.read())
+    assert [e["kind"] for e in doc["events"]] == ["eject"]
+    with _get(base, "/debug/events?scope=fleet") as r:
+        doc = json.loads(r.read())
+    assert replicas[0].addr in doc["replicas"]
+
+
+# --- DLREQ01 carriage: trace survives park/hand-off -----------------------
+
+@pytest.mark.router
+def test_handoff_and_preempt_keep_trace_id():
+    import jax
+
+    from dllama_tpu.models.config import tiny_config
+    from dllama_tpu.models.params import init_params
+    from dllama_tpu.parallel.mesh import make_mesh
+    from dllama_tpu.runtime.engine import Engine
+    from dllama_tpu.runtime.faults import injected
+    from dllama_tpu.runtime.scheduler import PRIORITY_LEVELS, SlotScheduler
+    from dllama_tpu.runtime.snapshot import loads_request
+
+    cfg = tiny_config(seq_len=64)
+    page = 4
+    pages_per_slot = -(-cfg.seq_len // page)
+
+    def mk(batch=1):
+        eng = Engine(cfg, init_params(cfg, seed=4),
+                     mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+                     batch=batch,
+                     kv_pages=batch * pages_per_slot + 1,
+                     kv_page_size=page)
+        return SlotScheduler(eng, prefill_chunk=4, max_wait_ms=20.0,
+                             decode_burst=4, preempt=True,
+                             preempt_age_ms=0.0, prefix_reuse=False)
+
+    sa, sb = mk(), mk()
+    tid = obs_trace.new_trace_id()
+    try:
+        # ---- hand-off leg: export from A, import into B ----
+        ev0 = obs_events.snapshot()["next_seq"]
+        with injected("engine.device_step=delay:0.05"):
+            t = sa.submit([5, 9, 2], 30, temperature=0.0)
+            obs_trace.set_trace(t.rid, tid)
+            it = t.tokens()
+            for _ in range(4):
+                next(it)
+            records = sa.handoff_export_all()
+        list(it)
+        # the record itself carries the trace id (cross-process carrier)
+        meta, _ = loads_request(records[t.rid])
+        assert meta["extra"]["trace_id"] == tid
+
+        t2, extra = sb.import_request(records[t.rid])
+        assert extra["trace_id"] == tid
+        # the importing process re-established rid→trace: resume spans
+        # and a same-id stitched dump need no further plumbing
+        assert obs_trace.trace_of(t2.rid) == tid
+        list(t2.tokens())
+        assert t2.finish == "length"
+
+        evs = obs_events.snapshot(ev0)["events"]
+        hand = [e for e in evs if e["kind"] == "handoff"
+                and e.get("rid") == t.rid]
+        dirs = {e.get("direction") for e in hand}
+        assert {"export", "import"} <= dirs, evs
+        assert all(e.get("trace") == tid for e in hand), hand
+
+        # spans recorded during the resume carry the trace id
+        resumed = [s for s in obs_trace.raw()["spans"]
+                   if s.get("rid") == t2.rid and s.get("trace") == tid]
+        assert resumed, "no resume span carried the trace id"
+
+        # ---- preempt-park-resume leg on B: same trace end to end ----
+        ev1 = obs_events.snapshot()["next_seq"]
+        done = {}
+
+        def run(key, prompt, n, prio):
+            tk = sb.submit(prompt, n, priority=prio)
+            if key == "batch":
+                obs_trace.set_trace(tk.rid, tid)
+            done[key] = (tk, list(tk.tokens()))
+
+        from dllama_tpu.runtime.faults import FAULTS
+        FAULTS.install("engine.device_step=delay:0.05x1000")
+        try:
+            bt = threading.Thread(target=run, args=(
+                "batch", [7, 3, 11], 24, PRIORITY_LEVELS["batch"]))
+            bt.start()
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if sb.occupancy()["active"] == 1:
+                    break
+                time.sleep(0.01)
+            time.sleep(0.2)
+            run("it", [2, 4, 6], 4, PRIORITY_LEVELS["interactive"])
+        finally:
+            FAULTS.clear()
+        bt.join(240)
+        assert done["batch"][0].finish == "length"
+
+        evs = obs_events.snapshot(ev1)["events"]
+        pre = [e for e in evs if e["kind"] == "preempt"]
+        res = [e for e in evs if e["kind"] == "resume"]
+        assert pre and res, evs
+        assert any(e.get("trace") == tid for e in pre), pre
+        assert any(e.get("trace") == tid for e in res), res
+        # causal order: the park precedes the re-admission
+        assert min(e["seq"] for e in pre) < max(e["seq"] for e in res)
+    finally:
+        sa.close()
+        sb.close()
+
+
+# --- tools ----------------------------------------------------------------
+
+class _FakeRouter:
+    """Canned router: the three surfaces fleet_top polls plus the
+    stitched fleet trace for trace_dump --fleet."""
+
+    def __init__(self):
+        tid = "0123abcd" * 4
+        self.health = {"status": "ok", "available": 2, "total": 2,
+                       "model": "tiny", "backends": [
+                           {"addr": "127.0.0.1:1001", "ejected": False,
+                            "draining": False, "retiring": False},
+                           {"addr": "127.0.0.1:1002", "ejected": False,
+                            "draining": False, "retiring": False}]}
+        self.fed = {"scope": "fleet", "router": {"uptime_s": 5.0},
+                    "replicas": {
+                        "127.0.0.1:1001": {"up": True, "metrics": {
+                            "sched_slots_occupied": 2,
+                            "sched_queue_depth": 1,
+                            "kv_pages_in_use": 30, "kv_pages_total": 60,
+                            "sched_goodput_ratio": 0.83,
+                            "slo_burn_rate": {"ttft/fast": 0.4,
+                                              "ttft/slow": 1.2},
+                            "requests_served": 11}},
+                        "127.0.0.1:1002": {"up": False, "stale": True,
+                                           "stale_age_s": 3.0,
+                                           "metrics": {
+                                               "requests_served": 4}}}}
+        self.events = {"scope": "fleet",
+                       "router": {"events": [
+                           {"kind": "eject", "ts": time.time(), "seq": 1,
+                            "replica": "127.0.0.1:1002",
+                            "why": "probe_failed"}],
+                           "next_seq": 1, "oldest_seq": 1,
+                           "capacity": 16},
+                       "replicas": {"127.0.0.1:1001": {"events": [
+                           {"kind": "resume", "ts": time.time(),
+                            "seq": 3, "rid": "r-1"}],
+                           "next_seq": 3, "oldest_seq": 1,
+                           "capacity": 16}}}
+        self.fleet_trace = {
+            "displayTimeUnit": "ms",
+            "fleet": {"router": {"up": True, "spans": 0},
+                      "127.0.0.1:1001": {"up": True, "spans": 1},
+                      "127.0.0.1:1002": {"up": True, "spans": 1}},
+            "traceEvents": [
+                {"name": "decode_chunk", "ph": "X", "ts": 1.0,
+                 "dur": 2.0, "pid": 2, "tid": 1,
+                 "args": {"trace_id": tid, "request_id": "r-1"}},
+                {"name": "decode_chunk", "ph": "X", "ts": 9.0,
+                 "dur": 2.0, "pid": 3, "tid": 1,
+                 "args": {"trace_id": tid, "request_id": "r-1"}},
+                {"name": "event:respawn", "ph": "i", "s": "p",
+                 "ts": 5.0, "pid": 1, "tid": 0, "args": {}}]}
+        outer = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path.startswith("/health"):
+                    doc = outer.health
+                elif self.path.startswith("/metrics"):
+                    doc = outer.fed
+                elif self.path.startswith("/debug/events"):
+                    doc = outer.events
+                elif self.path.startswith("/debug/trace"):
+                    doc = outer.fleet_trace
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = json.dumps(doc).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.base = f"http://127.0.0.1:{self.server.server_address[1]}"
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_fleet_top_once(capsys):
+    tool = _load_tool("fleet_top")
+    fr = _FakeRouter()
+    try:
+        assert tool.main([fr.base, "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "available=2/2" in out
+        # the healthy replica's row: occupancy, kv%, goodput, worst burn
+        assert "127.0.0.1:1001" in out and "50.0" in out \
+            and "0.830" in out and "1.20" in out
+        # the stale one renders marked, not dropped
+        assert "~DOWN" in out
+        # event tail merges router + replica journals
+        assert "eject" in out and "resume" in out
+    finally:
+        fr.close()
+    # unreachable router → clean failure
+    assert tool.main(["http://127.0.0.1:1", "--once"]) == 1
+
+
+def test_trace_dump_fleet(tmp_path, capsys):
+    tool = _load_tool("trace_dump")
+    fr = _FakeRouter()
+    try:
+        out = tmp_path / "fleet.json"
+        assert tool.main([fr.base, "--fleet", "-o", str(out)]) == 0
+        doc = json.loads(out.read_text())
+        assert doc["fleet"]["127.0.0.1:1001"]["spans"] == 1
+        printed = capsys.readouterr().out
+        assert "3 process(es)" in printed
+        # the migrated request is called out: one trace, two replicas
+        assert "span multiple replicas" in printed
+        assert "0123abcd" in printed
+    finally:
+        fr.close()
+    assert tool.main(["http://127.0.0.1:1", "--fleet",
+                      "-o", str(tmp_path / "x.json")]) == 1

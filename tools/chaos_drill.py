@@ -19,7 +19,10 @@ schedule.  The soak PASSES only if the whole crash-tolerance story held
   its full size once the workload quiesces;
 * **capacity restored** — the supervisor respawned every victim
   (``dllama_pod_respawns_total`` grew) and the registry re-admitted
-  them: fleet ``available`` is back to ``--dp``.
+  them: fleet ``available`` is back to ``--dp``;
+* **honest narration** — the pod event journal (``/debug/events``)
+  recorded the whole chain murder→respawn→readmit (and the reshape
+  start→done phases in ``--reshape`` mode) in causal ``seq`` order.
 
 Usage::
 
@@ -61,6 +64,47 @@ GREEDY_BODY = {"prompt": "Once upon a time", "max_tokens": 32,
 def get(base: str, path: str, timeout: float = 10.0) -> dict:
     with urllib.request.urlopen(base + path, timeout=timeout) as r:
         return json.loads(r.read())
+
+
+def journal_cursor(base: str) -> int:
+    """Current end of the pod's event journal (``/debug/events``)."""
+    return int(get(base, "/debug/events").get("next_seq", 0))
+
+
+def journal_since(base: str, since: int) -> list[dict]:
+    return get(base, f"/debug/events?since={since}").get("events") or []
+
+
+def check_murder_causality(events: list[dict], killed: int,
+                           check) -> None:
+    """The pod journal must tell the whole murder story in causal
+    (monotonic ``seq``) order: every recorded death is followed by a
+    respawn of the same replica, and every router ejection by a
+    readmit — the observable chain behind "capacity restored"."""
+    deaths = [e for e in events if e["kind"] == "death"]
+    respawns = [e for e in events if e["kind"] == "respawn"]
+    ejects = [e for e in events if e["kind"] == "eject"]
+    readmits = [e for e in events if e["kind"] == "readmit"]
+    check(len(deaths) >= killed,
+          f"journal recorded every murder "
+          f"(death x{len(deaths)}, killed {killed})")
+    orphans = [d for d in deaths
+               if not any(r["seq"] > d["seq"]
+                          and r.get("replica") == d.get("replica")
+                          for r in respawns)]
+    check(not orphans,
+          f"every death followed by a same-replica respawn in seq order"
+          + (f" (orphans: {orphans[:2]})" if orphans else ""))
+    check(len(ejects) >= 1,
+          f"router ejected at least one murdered replica "
+          f"(eject x{len(ejects)})")
+    unforgiven = [e for e in ejects
+                  if not any(r["seq"] > e["seq"]
+                             and r.get("replica") == e.get("replica")
+                             for r in readmits)]
+    check(not unforgiven,
+          f"every eject followed by a same-replica readmit in seq order"
+          + (f" (unforgiven: {unforgiven[:2]})" if unforgiven else ""))
 
 
 def stream_once(base: str, body: dict, out: dict | None = None,
@@ -290,6 +334,8 @@ def run_drill(*, quick: bool) -> int:
             oracle, fin = stream_once(pod.base, GREEDY_BODY)
             assert fin in ("stop", "length") and oracle, (fin, oracle)
 
+            ev0 = journal_cursor(pod.base)
+
             sampler = AvailabilitySampler(pod.base)
             sampler.start()
 
@@ -415,6 +461,10 @@ def run_drill(*, quick: bool) -> int:
                   f"replica_lost={m.get('router_replica_lost', 0)} "
                   f"retries={m.get('router_retries', 0)}")
 
+            # the event journal narrates the whole chain in seq order
+            check_murder_causality(journal_since(pod.base, ev0),
+                                   killed, check)
+
             # zero leaked KV pages once quiesced
             leaks = []
             deadline = time.monotonic() + 60
@@ -528,6 +578,8 @@ def run_reshape_drill(*, quick: bool) -> int:
             oracle, fin = stream_once(pod.base, GREEDY_BODY)
             assert fin in ("stop", "length") and oracle, (fin, oracle)
 
+            ev0 = journal_cursor(pod.base)
+
             sampler = AvailabilitySampler(pod.base)
             sampler.start()
 
@@ -617,6 +669,24 @@ def run_reshape_drill(*, quick: bool) -> int:
             events = m.get("pod_scale_events") or {}
             check(any(k.startswith("reshape") for k in events),
                   f"reshape recorded in pod_scale_events: {events}")
+
+            # the journal narrates the reshape phases + the murder in
+            # causal seq order: start before done, the death inside or
+            # after the window it interrupted
+            jev = journal_since(pod.base, ev0)
+            starts = [e for e in jev if e["kind"] == "reshape"
+                      and e.get("phase") == "start"]
+            dones = [e for e in jev if e["kind"] == "reshape"
+                     and e.get("phase") == "done"]
+            deaths = [e for e in jev if e["kind"] == "death"]
+            check(bool(starts) and bool(dones)
+                  and starts[0]["seq"] < dones[-1]["seq"],
+                  f"journal: reshape start→done in seq order "
+                  f"(starts x{len(starts)}, dones x{len(dones)})")
+            check(bool(deaths)
+                  and any(d["seq"] > starts[0]["seq"] for d in deaths),
+                  f"journal: mid-reshape murder recorded "
+                  f"(death x{len(deaths)} after reshape start)")
 
             # zero leaked KV pages on the surviving (new-shape) fleet
             leaks = []

@@ -1,0 +1,249 @@
+#!/usr/bin/env python3
+"""Live fleet dashboard for a dllama router / serve-pod front door.
+
+Polls three public surfaces of one router/pod process (stdlib only —
+no prometheus server, no grafana):
+
+* ``GET /health``                 — registry rows: who is ejected,
+                                    draining, retiring, and why
+* ``GET /metrics?scope=fleet``    — the federated JSON registry: every
+                                    replica's engine/scheduler/KV/SLO
+                                    families keyed by address
+* ``GET /debug/events?scope=fleet`` — the per-process event journals
+                                    (spawn/death/respawn/hand-off/…)
+
+and renders one screen: a per-replica table (occupancy, queue, KV
+pressure, goodput, SLO burn, requests served) over a scrolling event
+tail.  Uses curses when stdout is a terminal; ``--plain`` loops in
+plain text; ``--once`` prints a single plain snapshot and exits (the
+mode the tests drive).
+
+Usage:
+    python tools/fleet_top.py http://127.0.0.1:8080
+    python tools/fleet_top.py http://127.0.0.1:8080 --once
+    python tools/fleet_top.py http://127.0.0.1:8080 --plain -i 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from collections import deque
+
+
+def fetch_json(base: str, path: str, timeout: float) -> dict | None:
+    try:
+        with urllib.request.urlopen(f"{base.rstrip('/')}{path}",
+                                    timeout=timeout) as r:
+            return json.loads(r.read().decode("utf-8"))
+    except (OSError, ValueError, urllib.error.URLError):
+        return None
+
+
+def _num(snap: dict, key: str, default=None):
+    v = snap.get(key, default)
+    return v if isinstance(v, (int, float)) else default
+
+
+def _max_burn(snap: dict) -> float | None:
+    """Worst burn rate across objectives/windows (slo_burn_rate is a
+    labeled-gauge JSON dict keyed ``objective/window``)."""
+    burns = snap.get("slo_burn_rate")
+    if isinstance(burns, dict) and burns:
+        vals = [v for v in burns.values() if isinstance(v, (int, float))]
+        return max(vals) if vals else None
+    return None
+
+
+def replica_rows(health: dict | None, fed: dict | None) -> list[dict]:
+    """One row per replica: registry status joined with its federated
+    metrics snapshot (stale snapshots render with a ``~`` marker)."""
+    status: dict[str, dict] = {}
+    for b in (health or {}).get("backends", []):
+        addr = b.get("addr") or f"{b.get('host')}:{b.get('port')}"
+        status[addr] = b
+    rows = []
+    for addr, entry in ((fed or {}).get("replicas") or {}).items():
+        snap = entry.get("metrics") or {}
+        st = status.get(addr, {})
+        if not entry.get("up"):
+            state = "DOWN"
+        elif st.get("ejected") or entry.get("ejected"):
+            state = "ejected"
+        elif st.get("retiring") or entry.get("retiring"):
+            state = "retiring"
+        elif st.get("draining"):
+            state = "draining"
+        else:
+            state = "up"
+        rows.append({
+            "addr": addr,
+            "state": state,
+            "stale": bool(entry.get("stale")),
+            "slots": _num(snap, "sched_slots_occupied"),
+            "queue": _num(snap, "sched_queue_depth"),
+            "kv_used": _num(snap, "kv_pages_in_use"),
+            "kv_total": _num(snap, "kv_pages_total"),
+            "goodput": _num(snap, "sched_goodput_ratio"),
+            "burn": _max_burn(snap),
+            "served": _num(snap, "requests_served"),
+        })
+    rows.sort(key=lambda r: r["addr"])
+    return rows
+
+
+def _fmt(v, spec: str = "", dash: str = "-") -> str:
+    if v is None:
+        return dash
+    return format(v, spec)
+
+
+def format_rows(rows: list[dict]) -> list[str]:
+    hdr = (f"{'replica':<22} {'state':<9} {'slots':>5} {'queue':>5} "
+           f"{'kv%':>6} {'goodput':>7} {'burn':>6} {'served':>8}")
+    out = [hdr, "-" * len(hdr)]
+    for r in rows:
+        kv = None
+        if r["kv_used"] is not None and r["kv_total"]:
+            kv = 100.0 * r["kv_used"] / r["kv_total"]
+        mark = "~" if r["stale"] else ""
+        out.append(
+            f"{r['addr']:<22} {mark + r['state']:<9} "
+            f"{_fmt(r['slots'], '.0f'):>5} {_fmt(r['queue'], '.0f'):>5} "
+            f"{_fmt(kv, '.1f'):>6} {_fmt(r['goodput'], '.3f'):>7} "
+            f"{_fmt(r['burn'], '.2f'):>6} {_fmt(r['served'], '.0f'):>8}")
+    return out
+
+
+def format_event(src: str, ev: dict) -> str:
+    ts = time.strftime("%H:%M:%S", time.localtime(ev.get("ts", 0)))
+    extras = " ".join(f"{k}={v}" for k, v in sorted(ev.items())
+                      if k not in ("ts", "seq", "kind"))
+    return f"{ts} {src:<12} {ev.get('kind', '?'):<10} {extras}"
+
+
+class EventTail:
+    """Scrolling merge of every process's journal, deduplicated by a
+    per-source ``seq`` cursor (``fleet_events`` ``since`` only covers
+    the router's own journal — replica cursors live here)."""
+
+    def __init__(self, keep: int = 200):
+        self.cursors: dict[str, int] = {}
+        self.lines: deque = deque(maxlen=keep)
+
+    def _ingest(self, src: str, snap: dict | None) -> None:
+        if not snap or "events" not in snap:
+            return
+        cur = self.cursors.get(src, -1)
+        for ev in snap["events"]:
+            seq = ev.get("seq", -1)
+            if seq > cur:
+                self.lines.append((ev.get("ts", 0.0), format_event(src, ev)))
+                cur = max(cur, seq)
+        self.cursors[src] = cur
+
+    def update(self, doc: dict | None) -> None:
+        if not doc:
+            return
+        self._ingest("router", doc.get("router"))
+        for addr, snap in (doc.get("replicas") or {}).items():
+            self._ingest(addr, snap)
+
+    def tail(self, n: int) -> list[str]:
+        return [line for _, line in sorted(self.lines)[-n:]]
+
+
+def poll(base: str, timeout: float, tail: EventTail) -> dict:
+    health = fetch_json(base, "/health", timeout)
+    fed = fetch_json(base, "/metrics?scope=fleet", timeout)
+    events = fetch_json(base, "/debug/events?scope=fleet", timeout)
+    tail.update(events)
+    return {"health": health, "fed": fed,
+            "rows": replica_rows(health, fed)}
+
+
+def render_plain(base: str, snap: dict, tail: EventTail,
+                 events_n: int) -> str:
+    health = snap["health"] or {}
+    head = (f"fleet {base}  status={health.get('status', '?')}  "
+            f"available={health.get('available', '?')}/"
+            f"{health.get('total', '?')}  "
+            f"model={health.get('model', '?')}")
+    lines = [head, ""]
+    lines += format_rows(snap["rows"])
+    ev = tail.tail(events_n)
+    if ev:
+        lines += ["", "events:"] + [f"  {line}" for line in ev]
+    return "\n".join(lines)
+
+
+def run_curses(base: str, interval: float, timeout: float,
+               events_n: int) -> int:
+    import curses
+
+    tail = EventTail()
+
+    def loop(scr):
+        curses.use_default_colors()
+        scr.nodelay(True)
+        scr.timeout(int(interval * 1000))
+        while True:
+            snap = poll(base, timeout, tail)
+            scr.erase()
+            maxy, maxx = scr.getmaxyx()
+            text = render_plain(base, snap, tail,
+                                max(0, maxy - len(snap["rows"]) - 6))
+            for y, line in enumerate(text.splitlines()):
+                if y >= maxy - 1:
+                    break
+                scr.addnstr(y, 0, line, maxx - 1)
+            scr.refresh()
+            ch = scr.getch()
+            if ch in (ord("q"), 27):
+                return
+
+    curses.wrapper(loop)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("base", help="router/pod base URL, "
+                                 "e.g. http://127.0.0.1:8080")
+    ap.add_argument("-i", "--interval", type=float, default=2.0,
+                    help="poll interval, seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one plain-text snapshot and exit")
+    ap.add_argument("--plain", action="store_true",
+                    help="loop in plain text (no curses)")
+    ap.add_argument("--events", type=int, default=12,
+                    help="event-tail lines to show (default 12)")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+
+    if args.once or args.plain or not sys.stdout.isatty():
+        tail = EventTail()
+        while True:
+            snap = poll(args.base, args.timeout, tail)
+            if snap["health"] is None and snap["fed"] is None:
+                print(f"fleet_top: {args.base} unreachable",
+                      file=sys.stderr)
+                return 1
+            print(render_plain(args.base, snap, tail, args.events))
+            if args.once or not (args.plain or sys.stdout.isatty()):
+                return 0
+            print()
+            time.sleep(args.interval)
+    try:
+        return run_curses(args.base, args.interval, args.timeout,
+                          args.events)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -14,9 +14,19 @@ slot, named by that slot's phase (``prefill``/``decode``/``pad``), so the
 goodput decomposition is visible as colored bars next to the request
 spans — both use the same ``perf_counter`` clock.
 
+With ``--fleet`` the base URL is a *router* (or serve-pod front door)
+and the dump comes from ``GET /debug/trace?scope=fleet``: the router
+stitches every replica's span ring plus its own into one wall-clock-
+aligned Perfetto timeline — one named process track per replica, pod
+journal entries (spawn/death/respawn/hand-off/resume…) as instant
+markers — so a request that migrated across replicas shows up as one
+trace id spanning multiple tracks. ``--trace ID`` filters to one
+request's trace across the whole fleet.
+
 Usage:
     python tools/trace_dump.py http://127.0.0.1:9090 [-o trace.json] [-n 20]
     python tools/trace_dump.py http://127.0.0.1:9090 --slots
+    python tools/trace_dump.py http://127.0.0.1:8080 --fleet [--trace ID]
 """
 
 from __future__ import annotations
@@ -38,6 +48,40 @@ def fetch_timeline(base: str, n: int = 256, timeout: float = 10.0) -> dict:
     url = f"{base.rstrip('/')}/debug/timeline?n={n}"
     with urllib.request.urlopen(url, timeout=timeout) as r:
         return json.loads(r.read().decode("utf-8"))
+
+
+def fetch_fleet(base: str, trace: str | None,
+                timeout: float = 10.0) -> dict:
+    url = f"{base.rstrip('/')}/debug/trace?scope=fleet"
+    if trace:
+        url += f"&trace={trace}"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def summarize_fleet(doc: dict) -> str:
+    """Per-replica span/up table plus the distinct trace ids that span
+    more than one process — the migrated requests worth opening."""
+    fleet = doc.get("fleet", {})
+    spans = [e for e in doc.get("traceEvents", []) if e.get("ph") == "X"]
+    marks = [e for e in doc.get("traceEvents", []) if e.get("ph") == "i"]
+    lines = [f"{len(spans)} spans + {len(marks)} journal markers "
+             f"from {len(fleet)} process(es):"]
+    for name, info in sorted(fleet.items()):
+        up = "up" if info.get("up") else "DOWN"
+        lines.append(f"  {name:<22} {up:<5} {info.get('spans', 0):>5} spans")
+    # trace ids seen on more than one pid = cross-replica requests
+    procs: dict = {}
+    for e in spans:
+        tid = e.get("args", {}).get("trace_id")
+        if tid:
+            procs.setdefault(tid, set()).add(e.get("pid"))
+    crossed = sorted(t for t, p in procs.items() if len(p) > 1)
+    if crossed:
+        lines.append(f"  {len(crossed)} trace(s) span multiple replicas:")
+        for t in crossed[:8]:
+            lines.append(f"    {t}")
+    return "\n".join(lines)
 
 
 def slot_events(doc: dict) -> list[dict]:
@@ -122,8 +166,26 @@ def main(argv=None) -> int:
                          "track per scheduler slot (phase-named events)")
     ap.add_argument("--timeline-n", type=int, default=256,
                     help="with --slots: number of most-recent dispatches")
+    ap.add_argument("--fleet", action="store_true",
+                    help="base is a router/pod: fetch the stitched "
+                         "fleet-wide trace (/debug/trace?scope=fleet)")
+    ap.add_argument("--trace", default=None,
+                    help="with --fleet: filter to one trace id")
     ap.add_argument("--timeout", type=float, default=10.0)
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        try:
+            doc = fetch_fleet(args.base, args.trace, args.timeout)
+        except Exception as e:
+            print(f"trace_dump: fleet fetch failed: {e}", file=sys.stderr)
+            return 1
+        with open(args.out, "w") as f:
+            json.dump(doc, f)
+        print(f"wrote {args.out} — load it in chrome://tracing or "
+              f"https://ui.perfetto.dev")
+        print(summarize_fleet(doc))
+        return 0
 
     try:
         doc = fetch_trace(args.base, args.last, args.timeout)

@@ -329,6 +329,13 @@ def replay_trace(base: str, trace: dict, *, speed: float = 1.0,
     rng = random.Random(seed)
     mix_cum = parse_mix(mix) if mix else None
     before = _counter_totals(_get_json(base, "/metrics"))
+    # event-journal cursor: whatever the pod journal records during the
+    # replay window (respawns, hand-offs, preemptions…) lands in the
+    # report — the drill's causal context next to the latency numbers
+    try:
+        ev_cursor = _get_json(base, "/debug/events").get("next_seq")
+    except Exception:
+        ev_cursor = None
     sampler = None
     if availability_bound_s is not None or sample_availability:
         sampler = AvailabilitySampler(base)
@@ -405,6 +412,17 @@ def replay_trace(base: str, trace: dict, *, speed: float = 1.0,
     report = {"base": base, "speed": speed, "wall_s": round(wall, 3),
               "requests": len(rows), "classes": classes,
               "metric_deltas": deltas, "server_slo_status": slo}
+    if ev_cursor is not None:
+        try:
+            snap = _get_json(base, f"/debug/events?since={ev_cursor}")
+            events = snap.get("events") or []
+            kinds: dict[str, int] = {}
+            for ev in events:
+                kinds[ev["kind"]] = kinds.get(ev["kind"], 0) + 1
+            report["journal_events"] = {"count": len(events),
+                                        "kinds": kinds, "events": events}
+        except Exception:
+            pass
     if sampler is not None:
         report["availability"] = sampler.report(availability_bound_s)
     return report
@@ -438,6 +456,10 @@ def print_report(report: dict) -> None:
             print(f"    {k:<40} +{v}")
     if report.get("server_slo_status"):
         print(f"  server SLO status: {report['server_slo_status']}")
+    jev = report.get("journal_events")
+    if jev and jev["count"]:
+        mix = " ".join(f"{k}={v}" for k, v in sorted(jev["kinds"].items()))
+        print(f"  journal events during replay: {jev['count']} ({mix})")
     avail = report.get("availability")
     if avail:
         verdict = f"  verdict={avail['verdict']}" if "verdict" in avail \
