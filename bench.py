@@ -65,6 +65,16 @@ RELAY_HOST = (os.environ.get("PALLAS_AXON_POOL_IPS", "").split(",")[0].strip()
               or "127.0.0.1")
 
 
+def _vs_baseline(toks, baseline):
+    """The one headline-vs-reference helper: tok/s over the published
+    reference tok/s for EVERY stage (ms/token stages convert to tok/s
+    before calling).  ``None`` — never a crash — when the stage has no
+    baseline to compare against."""
+    if not baseline or not isinstance(toks, (int, float)):
+        return None
+    return round(toks / baseline, 2)
+
+
 def current_round() -> int | None:
     """The driver's round number from PROGRESS.jsonl's last line — the ONE
     shared parser for the in-session artifact's freshness gate (bench, the
@@ -550,6 +560,21 @@ def _bench_sched(cfg, slots=4, max_new=96, tp=1):
         print(f"bench: sched goodput "
               f"{obs_metrics.SCHED_GOODPUT_RATIO.value:.3f} ({split})",
               file=sys.stderr)
+    # roofline utilization (obs/cost.py): achieved FLOP/s and HBM bytes/s
+    # over the backend's peaks — the per-stage economics line
+    from dllama_tpu.obs import cost as obs_cost
+    perf = obs_cost.summary()
+    if perf.get("mfu") is not None or perf.get("mbu") is not None:
+        mfu = perf.get("mfu")
+        mbu = perf.get("mbu")
+        print(f"bench: sched mfu={mfu:.4f}" if mfu is not None
+              else "bench: sched mfu=n/a", file=sys.stderr, end="")
+        print(f" mbu={mbu:.4f}" if mbu is not None else " mbu=n/a",
+              file=sys.stderr, end="")
+        print(f" ({perf['peaks'].get('source', '?')} peaks, "
+              f"{perf['flops_total'] / 1e9:.2f} GFLOP, "
+              f"{perf['hbm_bytes_total'] / 1e9:.3f} GB moved)",
+              file=sys.stderr)
     return total / elapsed
 
 
@@ -850,8 +875,15 @@ def _bank_stage_metrics(name):
     try:
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         from dllama_tpu.obs import metrics as obs_metrics
+        snap = obs_metrics.snapshot_json()
+        # provenance stamp: which bench run and which tree produced this
+        # row, plus the registry schema it speaks — so perf_sentinel.py
+        # can pair rows across rounds without guessing
         line = json.dumps({"stage": name, "ts": round(time.time(), 3),
-                           "metrics": obs_metrics.snapshot_json()})
+                           "schema_version": snap.get("schema_version"),
+                           "bench_run_id": os.environ.get("BENCH_RUN_ID"),
+                           "git_sha": os.environ.get("BENCH_GIT_SHA"),
+                           "metrics": snap})
         with open(path, "a") as f:
             f.write(line + "\n")
     except Exception as e:  # noqa: BLE001 — evidence, never the number
@@ -887,7 +919,7 @@ def _attempt_body(name):
             "metric": "llama2-7b q40 greedy decode tok/s "
                       "(1 TPU chip, dllama inference CLI end-to-end)",
             "value": round(1000.0 / ms, 2), "unit": "tok/s",
-            "vs_baseline": round(1000.0 / ms / BASELINE_7B_TOKS, 2),
+            "vs_baseline": _vs_baseline(1000.0 / ms, BASELINE_7B_TOKS),
             "backend": jax.default_backend()}))
         return
 
@@ -931,8 +963,8 @@ def _attempt_body(name):
                       f"slots=4 aggregate decode tok/s "
                       f"(staggered arrivals, {impl})",
             "value": round(toks, 2), "unit": "tok/s",
-            "vs_baseline": round(toks / BASELINE_7B_TOKS, 2)
-            if base == "llama2-7b" else None,
+            "vs_baseline": _vs_baseline(
+                toks, BASELINE_7B_TOKS if base == "llama2-7b" else None),
             "collective_ms_avg": round(coll.sum / coll.count, 3)
             if coll.count else None,
             "backend": jax.default_backend()}))
@@ -960,8 +992,8 @@ def _attempt_body(name):
                       f"decode tok/s (prompt-lookup drafts, spec_k=4, "
                       f"{impl})",
             "value": round(on["toks"], 2), "unit": "tok/s",
-            "vs_baseline": round(on["toks"] / BASELINE_7B_TOKS, 2)
-            if base == "llama2-7b" else None,
+            "vs_baseline": _vs_baseline(
+                on["toks"], BASELINE_7B_TOKS if base == "llama2-7b" else None),
             "spec_off_toks": round(off["toks"], 2),
             "spec_speedup": round(on["toks"] / off["toks"], 3)
             if off["toks"] else None,
@@ -990,8 +1022,8 @@ def _attempt_body(name):
             "metric": f"{base} q40 continuous-batching slots=4 aggregate "
                       f"decode tok/s (staggered arrivals, {impl})",
             "value": round(toks, 2), "unit": "tok/s",
-            "vs_baseline": round(toks / BASELINE_7B_TOKS, 2)
-            if base == "llama2-7b" else None,
+            "vs_baseline": _vs_baseline(
+                toks, BASELINE_7B_TOKS if base == "llama2-7b" else None),
             "backend": jax.default_backend()}))
         return
 
@@ -1015,8 +1047,8 @@ def _attempt_body(name):
             "metric": f"{base} q40 overlapped-dispatch slots=4 pure-decode "
                       f"aggregate tok/s (two-deep pipeline on, {impl})",
             "value": round(on["toks"], 2), "unit": "tok/s",
-            "vs_baseline": round(on["toks"] / BASELINE_7B_TOKS, 2)
-            if base == "llama2-7b" else None,
+            "vs_baseline": _vs_baseline(
+                on["toks"], BASELINE_7B_TOKS if base == "llama2-7b" else None),
             "sync_toks": round(off["toks"], 2),
             "overlap_speedup": round(on["toks"] / off["toks"], 3)
             if off["toks"] else None,
@@ -1050,8 +1082,8 @@ def _attempt_body(name):
                       f"tok/s (optimistic reservation, pool at 40% of "
                       f"full demand, {impl})",
             "value": round(toks, 2), "unit": "tok/s",
-            "vs_baseline": round(toks / BASELINE_7B_TOKS, 2)
-            if base == "llama2-7b" else None,
+            "vs_baseline": _vs_baseline(
+                toks, BASELINE_7B_TOKS if base == "llama2-7b" else None),
             "spill_pages": spilled,
             "pagein_pages": paged_in,
             "backend": jax.default_backend()}))
@@ -1076,8 +1108,8 @@ def _attempt_body(name):
                       f"aggregate decode tok/s (128-token shared system "
                       f"prompt, {impl})",
             "value": round(toks, 2), "unit": "tok/s",
-            "vs_baseline": round(toks / BASELINE_7B_TOKS, 2)
-            if base == "llama2-7b" else None,
+            "vs_baseline": _vs_baseline(
+                toks, BASELINE_7B_TOKS if base == "llama2-7b" else None),
             "prefix_tokens_reused": int(reused),
             "backend": jax.default_backend()}))
         return
@@ -1160,8 +1192,8 @@ def _attempt_body(name):
             "metric": f"{name} {codec_label} lockstep batch={batch} aggregate decode "
                       f"tok/s (1 TPU chip, {impl})",
             "value": round(toks, 2), "unit": "tok/s",
-            "vs_baseline": round(toks / BASELINE_7B_TOKS, 2)
-            if name == "llama2-7b" else None,
+            "vs_baseline": _vs_baseline(
+                toks, BASELINE_7B_TOKS if name == "llama2-7b" else None),
             "backend": backend}))
         return
     if name == "llama2-7b-long":
@@ -1176,10 +1208,10 @@ def _attempt_body(name):
         metric = f"llama2-7b {codec_label} greedy decode tok/s (1 TPU chip, {impl})"
         if chunk_override:
             metric += f" [chunk={chunk}]"
-        vs = round(toks / BASELINE_7B_TOKS, 2)
+        vs = _vs_baseline(toks, BASELINE_7B_TOKS)
     elif name == "llama2-13b":
         metric = f"llama2-13b {codec_label} greedy decode tok/s (1 TPU chip, {impl})"
-        vs = round(toks / BASELINE_13B_TOKS, 2)
+        vs = _vs_baseline(toks, BASELINE_13B_TOKS)
     elif name == "tinyllama-1.1b":
         metric = f"tinyllama-1.1b {codec_label} greedy decode tok/s (1 TPU chip, {impl})"
         vs = None  # no published reference number for this config
@@ -1244,6 +1276,37 @@ def _spawn(name, timeout_s, env_extra=None):
 _EMITTED = False
 
 
+def _sentinel_verdict(result, extras):
+    """Compare this run's result against the newest banked round with
+    tools/perf_sentinel.py and ride the verdict in ``extras`` — evidence
+    for the round notes, never a gate (any failure here is logged and
+    swallowed; the bench number always lands)."""
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        rounds = sorted(f for f in os.listdir(here)
+                        if f.startswith("BENCH_r") and f.endswith(".json"))
+        if not rounds:
+            return extras
+        sys.path.insert(0, os.path.join(here, "tools"))
+        import perf_sentinel
+        base = perf_sentinel.load_any(os.path.join(here, rounds[-1]))
+        cur = perf_sentinel.normalize_result(
+            dict(result, extras=extras or {}))
+        rep = perf_sentinel.compare(base, cur)
+        extras = dict(extras or {})
+        extras["perf_sentinel"] = {
+            "vs": rounds[-1], "verdict": rep["verdict"],
+            "compared": rep["compared"],
+            "regressions": rep["regressions"]}
+        print(f"bench: perf sentinel vs {rounds[-1]}: {rep['verdict']} "
+              f"({rep['compared']} comparable)", file=sys.stderr)
+        return extras
+    except Exception as e:  # noqa: BLE001 — evidence, never the number
+        print(f"bench: perf sentinel skipped ({type(e).__name__}: {e})",
+              file=sys.stderr)
+        return extras
+
+
 def _emit(result, extras=None):
     """Write the result line with SIGTERM blocked: one atomic os.write of
     the full payload, flag set under the mask — no window in which a kill
@@ -1251,6 +1314,7 @@ def _emit(result, extras=None):
     global _EMITTED
     import signal
     result.pop("backend", None)
+    extras = _sentinel_verdict(result, extras)
     if extras:
         result["extras"] = extras
     payload = (json.dumps(result) + "\n").encode()
@@ -1321,6 +1385,21 @@ def main():
     except OSError:
         pass
     os.environ["BENCH_METRICS_BANK"] = bank
+    # provenance for every banked row: one run id for the whole bench
+    # invocation (children inherit it) and the tree it measured
+    os.environ.setdefault(
+        "BENCH_RUN_ID", f"{int(t_start)}-{os.getpid()}")
+    if "BENCH_GIT_SHA" not in os.environ:
+        try:
+            sha = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+                timeout=10).stdout.decode().strip()
+            if sha:
+                os.environ["BENCH_GIT_SHA"] = sha
+        except Exception:
+            pass
 
     def remaining():
         return BUDGET_S - (time.time() - t_start)

@@ -19,6 +19,11 @@ Three stdlib-only building blocks, threaded through every layer:
   dense), labeled degrade counters replacing the old warn-once prints,
   and the process-wide ``degraded`` flag that ``/health`` and the
   end-of-run CLI summary surface.
+* :mod:`.cost` — the analytic roofline cost model: FLOPs/bytes-moved
+  per dispatch family computed from the model config and dispatch shape
+  (no device counters), the per-backend peak table behind the
+  ``dllama_mfu`` / ``dllama_mbu`` gauges, and per-request chip-time
+  attribution feeding the flight recorder's cost block.
 * :mod:`.flight` — the request flight recorder (per-request lifecycle
   records keyed by ``X-Request-Id``, served at ``/debug/requests``) and
   the per-dispatch slot timeline behind ``/debug/timeline`` and the
@@ -39,4 +44,5 @@ metric bump on the decode hot path costs one small lock.
 
 from __future__ import annotations
 
-from . import dispatch, events, flight, log, metrics, slo, trace  # noqa: F401
+from . import cost, dispatch, events, flight, log, metrics, slo, \
+    trace  # noqa: F401

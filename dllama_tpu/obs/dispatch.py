@@ -76,6 +76,23 @@ def record_degrade(codec: str, reason: str, *, warn_key=None, **ctx) -> None:
                      extra={"codec": codec, "reason": reason, **ctx})
 
 
+def record_cost(entries: dict) -> None:
+    """Bump the analytic roofline counters for one landed dispatch.
+
+    ``entries`` is :meth:`obs.cost.CostModel.dispatch_cost`'s
+    ``{(codec, path, phase): {"flops": n, "bytes": n}}`` map — the
+    runtime side of the ledger: :func:`record_dispatch` says which path
+    a call site *compiled*, this says what the landed dispatches *cost*.
+    """
+    for (codec, path, phase), e in entries.items():
+        if e.get("flops"):
+            obs_metrics.DISPATCH_FLOPS.inc(codec, path, phase,
+                                           n=e["flops"])
+        if e.get("bytes"):
+            obs_metrics.DISPATCH_BYTES.inc(codec, path, phase,
+                                           n=e["bytes"])
+
+
 def degraded() -> bool:
     """True once any dispatch degraded off its fast path this process."""
     with _lock:
@@ -144,3 +161,10 @@ def reset() -> None:
     obs_metrics.MATMUL_DISPATCH.reset()
     obs_metrics.Q40_DEGRADE.reset()
     obs_metrics.Q8_DEGRADE.reset()
+    obs_metrics.DISPATCH_FLOPS.reset()
+    obs_metrics.DISPATCH_BYTES.reset()
+    obs_metrics.CLASS_CHIP_MS.reset()
+    obs_metrics.MFU.reset()
+    obs_metrics.MBU.reset()
+    from . import cost as obs_cost
+    obs_cost.TRACKER.reset()

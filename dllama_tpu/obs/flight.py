@@ -134,6 +134,31 @@ class FlightRecorder:
                 entry[k] = round(v, 3) if isinstance(v, float) else v
             rec["phases"].append(entry)
 
+    def cost(self, rid=None, **inc) -> None:
+        """Accumulate roofline cost attribution (``chip_ms``, ``flops``,
+        ``hbm_bytes``, ``kv_page_ms``) into the record's cost block —
+        one call per dispatch the request rode, raw floats summed here
+        and rounded only at exposition (get/recent)."""
+        rid = self._rid(rid)
+        if rid is None:
+            return
+        with self._lock:
+            rec = self._get_locked(rid)
+            if rec is None:
+                return
+            cost = rec.setdefault(
+                "cost", {"chip_ms": 0.0, "flops": 0.0,
+                         "hbm_bytes": 0.0, "kv_page_ms": 0.0})
+            for k, v in inc.items():
+                cost[k] = cost.get(k, 0.0) + float(v)
+
+    @staticmethod
+    def _cost_view(rec: dict) -> dict | None:
+        cost = rec.get("cost")
+        if cost is None:
+            return None
+        return {k: round(v, 3) for k, v in cost.items()}
+
     def first_token(self, rid=None, ttft_s: float = 0.0) -> None:
         """The exact value the serving layer observed into the TTFT
         histogram — stored verbatim so record and histogram agree."""
@@ -195,19 +220,25 @@ class FlightRecorder:
             out = dict(rec)
             out["phases"] = [dict(p) for p in rec["phases"]]
             out["itl"] = dict(rec["itl"])
+            if "cost" in rec:
+                out["cost"] = self._cost_view(rec)
             out.pop("degrade_base", None)
             return out
 
     def recent(self, n: int = 50) -> list[dict]:
         """Newest-first summaries for ``GET /debug/requests``."""
         with self._lock:
-            recs = list(self._records.values())[-max(0, n):]
+            recs = [dict(rec)
+                    for rec in list(self._records.values())[-max(0, n):]]
         out = []
         for rec in reversed(recs):
-            out.append({k: rec.get(k) for k in
-                        ("request_id", "submitted_at", "slot", "n_prompt",
-                         "produced", "queued_ms", "ttft_s", "duration_ms",
-                         "finish", "path", "priority", "preempt_count")})
+            row = {k: rec.get(k) for k in
+                   ("request_id", "submitted_at", "slot", "n_prompt",
+                    "produced", "queued_ms", "ttft_s", "duration_ms",
+                    "finish", "path", "priority", "preempt_count")}
+            if "cost" in rec:
+                row["cost"] = self._cost_view(rec)
+            out.append(row)
         return out
 
     def __len__(self) -> int:
@@ -291,6 +322,10 @@ def admit(rid=None, **kw) -> None:
 
 def phase(rid=None, kind: str = "", **fields) -> None:
     RECORDER.phase(rid, kind, **fields)
+
+
+def cost(rid=None, **inc) -> None:
+    RECORDER.cost(rid, **inc)
 
 
 def first_token(rid=None, ttft_s: float = 0.0) -> None:

@@ -536,6 +536,35 @@ Q8_DEGRADE = REGISTRY.labeled_counter(
     "q8_degrade", "reason",
     "Q80 dispatches degraded off the fused Pallas path, by reason.")
 
+# performance economics (obs/cost.py): the analytic roofline model's
+# FLOPs / bytes-moved per dispatch family, per-class chip-time
+# attribution, and the MFU/MBU utilization gauges (achieved rate over
+# the per-backend peak table).  Bumped by the scheduler at dispatch-land
+# time through the ledger seam (dispatch.record_cost).
+DISPATCH_FLOPS = REGISTRY.labeled_counter(
+    "dispatch_flops", ("codec", "path", "phase"),
+    "Model FLOPs per analytic dispatch family: weight codec or KV codec, "
+    "cost path (matmul / attention / paged-gather / paged-decode / "
+    "tp-ring), and request phase (prefill / decode / verify).")
+DISPATCH_BYTES = REGISTRY.labeled_counter(
+    "dispatch_bytes", ("codec", "path", "phase"),
+    "Bytes moved per analytic dispatch family (same labels as "
+    "dispatch_flops): packed weight reads, KV reads+writes (page-"
+    "granular when paged), and TP ring all-reduce hop bytes.")
+CLASS_CHIP_MS = REGISTRY.labeled_counter(
+    "class_chip_ms", "class",
+    "Chip-time attributed to retired+live requests by QoS class "
+    "(interactive / standard / batch): each dispatch's wall pro-rated "
+    "across its occupied rows — cost-per-tenant as a scrape.")
+MFU = REGISTRY.gauge(
+    "mfu",
+    "Model FLOPs utilization: achieved FLOP/s over dispatch wall divided "
+    "by the backend peak (obs/cost.py peak table; CPU measures once).")
+MBU = REGISTRY.gauge(
+    "mbu",
+    "Memory-bandwidth utilization: achieved HBM bytes/s over dispatch "
+    "wall divided by the backend peak (TP ring bytes excluded).")
+
 # compile telemetry (runtime/engine.py): bucketed-prefill recompiles vs
 # executable-cache hits, and how long each fresh compile stalled the host
 COMPILE_S_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,

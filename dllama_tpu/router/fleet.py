@@ -139,6 +139,37 @@ def merge_prometheus(per_replica: list[tuple[str, str]]) -> str:
     return "\n".join(out) + "\n"
 
 
+def fleet_perf(replicas: dict) -> dict:
+    """Fleet performance-economics rollup for the federated JSON: mean
+    MFU/MBU across replicas that reported one, and class chip-time
+    summed fleet-wide with per-class shares — the block ``fleet_top``'s
+    footer renders, computed once here instead of in every dashboard."""
+    mfus: list[float] = []
+    mbus: list[float] = []
+    by_class: dict[str, float] = {}
+    for entry in (replicas or {}).values():
+        snap = entry.get("metrics") or {}
+        for key, acc in (("mfu", mfus), ("mbu", mbus)):
+            v = snap.get(key)
+            if isinstance(v, (int, float)) and v > 0:
+                acc.append(float(v))
+        cc = snap.get("class_chip_ms")
+        if isinstance(cc, dict):
+            for k, v in cc.items():
+                if isinstance(v, (int, float)):
+                    by_class[k] = by_class.get(k, 0.0) + float(v)
+    total = sum(by_class.values())
+    return {
+        "mfu_mean": round(sum(mfus) / len(mfus), 6) if mfus else None,
+        "mbu_mean": round(sum(mbus) / len(mbus), 6) if mbus else None,
+        "class_chip_ms": {k: round(v, 3)
+                          for k, v in sorted(by_class.items())},
+        "class_chip_share": {k: round(v / total, 4)
+                             for k, v in sorted(by_class.items())}
+        if total else {},
+    }
+
+
 class FleetScraper:
     """Concurrent scraper over the registry's full backend list."""
 
@@ -222,7 +253,8 @@ class FleetScraper:
         obs_metrics.FLEET_SCRAPE_SECONDS.observe(time.perf_counter() - t0)
         return {"scope": "fleet",
                 "router": router_metrics or obs_metrics.snapshot_json(),
-                "replicas": replicas}
+                "replicas": replicas,
+                "perf": fleet_perf(replicas)}
 
     def federated_prometheus(self) -> str:
         """Fleet-scope Prometheus text: every sample — the router's own

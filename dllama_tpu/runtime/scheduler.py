@@ -79,6 +79,7 @@ from collections import deque
 
 import numpy as np
 
+from ..obs import cost as obs_cost, dispatch as obs_dispatch
 from ..obs import events as obs_events, flight as obs_flight
 from ..obs import metrics as obs_metrics, trace as obs_trace
 from ..obs.log import get_logger, new_request_id, request_id_var
@@ -352,6 +353,11 @@ class SlotScheduler:
         self._idle_accum = 0.0     # seconds slept in _cond.wait since last dispatch
         self._comp = {"prefill": 0.0, "decode": 0.0, "pad": 0.0,
                       "host_gap": 0.0, "idle": 0.0}
+        # roofline cost attribution (obs/cost.py): analytic FLOPs/bytes
+        # per landed dispatch, pro-rated across occupied rows.  None when
+        # the engine shape could not be modeled — serving never depends
+        # on the accounting.
+        self.cost_model = obs_cost.model_from_engine(engine)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="dllama-slot-scheduler")
         self._thread.start()
@@ -1884,6 +1890,54 @@ class SlotScheduler:
                         t0_mono=time.monotonic(), host_gap_ms=0.0,
                         idle_ms=0.0, overlapped=True, queued=0)
 
+    def _attribute_cost(self, cur: _Pending, wall_ms: float) -> None:
+        """Analytic roofline attribution for one landed dispatch
+        (obs/cost.py): ledger FLOPs/bytes counters by (codec, path,
+        phase), a cost block on every riding request's flight record,
+        per-class chip-time, and the MFU/MBU gauges.
+
+        A row's chip-time share is ``wall_ms / batch`` — summed over the
+        occupied rows of every dispatch that is exactly the busy
+        (prefill + decode) goodput component, so per-request chip time
+        telescopes the same way the goodput clock does (pad rows' share
+        is capacity waste, attributed to nobody).  FLOPs/bytes use each
+        row's own useful tokens; the per-pass weight read is split
+        evenly across occupied rows (that IS the batching
+        amortization)."""
+        cm = self.cost_model
+        if cm is None or not cur.active:
+            return
+        rows = []
+        for i in cur.active:
+            if i in cur.prefset:
+                rows.append(("prefill", int(cur.pos_rows[i]),
+                             int(cur.n_valid[i])))
+            elif cur.verify and (cur.proposed_by_slot or {}).get(i):
+                rows.append(("verify", int(cur.pos_rows[i]),
+                             int(cur.n_valid[i])))
+            else:
+                # plain decode rows advance cur.steps tokens (1 inside a
+                # mixed or verify dispatch)
+                rows.append(("decode", int(cur.pos_rows[i]),
+                             int(cur.steps)))
+        out = cm.dispatch_cost(rows, steps=cur.steps)
+        obs_dispatch.record_cost(out["entries"])
+        obs_cost.TRACKER.note(out["flops"], out["hbm_bytes"], wall_ms)
+        mfu, mbu = obs_cost.TRACKER.mfu(), obs_cost.TRACKER.mbu()
+        if mfu is not None:
+            obs_metrics.MFU.set(mfu)
+        if mbu is not None:
+            obs_metrics.MBU.set(mbu)
+        chip_ms = wall_ms / self.engine.batch
+        for i, rc in zip(cur.active, out["per_row"]):
+            pages = len(self.slots[i].pages) if self.paged else 0
+            obs_flight.cost(cur.rid_by_slot.get(i), chip_ms=chip_ms,
+                            flops=rc["flops"], hbm_bytes=rc["hbm_bytes"],
+                            kv_page_ms=pages * wall_ms)
+            t = (cur.tickets or {}).get(i)
+            cls = PRIORITY_NAMES.get(getattr(t, "priority", 1), "standard")
+            obs_metrics.CLASS_CHIP_MS.inc(cls, n=chip_ms)
+
     def _land_and_fanout(self, cur: _Pending) -> bool:
         """Block until ``cur``'s tokens land, charge the goodput clock,
         and fan the tokens out to their tickets.  Returns False when the
@@ -2031,6 +2085,7 @@ class SlotScheduler:
                 obs_flight.phase(rid, "decode_burst", steps=cur.steps,
                                  tokens=emitted[i], wall_ms=wall_ms,
                                  step_ms=step_ms)
+        self._attribute_cost(cur, wall_ms)
         obs_flight.TIMELINE.record_step(
             ts=ts0, wall_ms=wall_ms,
             device_ms=getattr(eng, "last_slot_dispatch_ms", None),

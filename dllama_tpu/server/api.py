@@ -71,8 +71,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs
 
 from ..io.integrity import ArtifactError
-from ..obs import dispatch as obs_dispatch, events as obs_events, \
-    flight as obs_flight, metrics as obs_metrics, trace as obs_trace
+from ..obs import cost as obs_cost, dispatch as obs_dispatch, \
+    events as obs_events, flight as obs_flight, metrics as obs_metrics, \
+    trace as obs_trace
 from ..obs.log import (configure as configure_logging, get_logger,
                        new_request_id, set_request_id)
 from ..runtime.engine import ContextOverflow, Engine, NumericFault, StepTimeout
@@ -663,6 +664,10 @@ class ApiState:
             # objective plus the burn rates behind the call — evaluated
             # live, so the health probe IS the alerting primitive
             "slo": self.slo.evaluate() if self.slo is not None else None,
+            # performance economics (obs/cost.py): MFU/MBU against the
+            # backend peak table, cumulative modeled work, and chip-time
+            # by QoS class — cost-per-tenant as a health probe
+            "perf": obs_cost.summary(),
         }
 
     # ------------------------------------------------------------------

@@ -122,6 +122,33 @@ def test_chat_completion_non_stream(server):
     assert u["total_tokens"] == u["prompt_tokens"] + u["completion_tokens"]
 
 
+def test_health_perf_block_and_metrics_gauges(server):
+    """Performance-economics plane over the real HTTP surface: after a
+    served completion, /health carries the roofline summary and both
+    /metrics expositions carry the MFU/MBU gauges (obs/cost.py)."""
+    # /v1/completions rides the slot scheduler (the attribution seam);
+    # an uncontended chat request would take the mutex path instead
+    body = {"prompt": "hello", "max_tokens": 6, "temperature": 0}
+    with post(server, "/v1/completions", body) as r:
+        r.read()
+    with urllib.request.urlopen(server + "/health", timeout=10) as r:
+        health = json.loads(r.read())
+    perf = health["perf"]
+    assert perf["flops_total"] > 0 and perf["hbm_bytes_total"] > 0
+    assert "mfu" in perf and "mbu" in perf and "peaks" in perf
+    assert perf["chip_ms_by_class"]  # the served request bought chip time
+    with urllib.request.urlopen(server + "/metrics", timeout=10) as r:
+        js = json.loads(r.read())
+    assert "mfu" in js and "mbu" in js
+    assert js["dispatch_flops"] and js["class_chip_ms"]
+    with urllib.request.urlopen(server + "/metrics?format=prometheus",
+                                timeout=10) as r:
+        txt = r.read().decode()
+    assert "dllama_mfu" in txt and "dllama_mbu" in txt
+    assert "dllama_dispatch_flops_total" in txt
+    assert "dllama_class_chip_ms_total{" in txt
+
+
 def test_chat_completion_stream_sse(server):
     body = {"messages": [{"role": "user", "content": "hello"}],
             "max_tokens": 8, "temperature": 0, "stream": True, "seed": 1}

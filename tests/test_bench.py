@@ -27,7 +27,9 @@ import bench  # noqa: E402
 
 
 def test_emit_contract(capfd):
-    """One parseable line; backend stripped; extras riding along."""
+    """One parseable line; backend stripped; extras riding along (plus
+    the perf-sentinel verdict when a previous banked round exists next
+    to bench.py — evidence, never a gate)."""
     bench._emit({"metric": "m", "value": 1.5, "unit": "tok/s",
                  "vs_baseline": None, "backend": "tpu"},
                 {"llama3-8b_toks": 88.0})
@@ -36,7 +38,13 @@ def test_emit_contract(capfd):
     assert len(lines) == 1
     obj = json.loads(lines[0])
     assert obj["value"] == 1.5 and "backend" not in obj
-    assert obj["extras"] == {"llama3-8b_toks": 88.0}
+    extras = obj["extras"]
+    assert extras["llama3-8b_toks"] == 88.0
+    sentinel = extras.pop("perf_sentinel", None)
+    assert extras == {"llama3-8b_toks": 88.0}
+    if sentinel is not None:  # this checkout has banked rounds
+        assert sentinel["verdict"] in ("ok", "regression")
+        assert sentinel["vs"].startswith("BENCH_r")
 
 
 def test_relay_listening_gate(monkeypatch):

@@ -1,0 +1,414 @@
+"""Performance-economics plane tests (obs/cost.py + perf sentinel).
+
+Three layers of evidence, tier-1 on CPU:
+
+* **hand counts** — the roofline model's FLOPs/bytes for the tiny
+  config are recomputed here from first principles as literal
+  arithmetic (one prefill chunk, one decode step, one paged-int8
+  decode, one tp=2 ring hop, one decode burst) and must match
+  ``CostModel`` EXACTLY — the model is only trustworthy because it is
+  small enough to check token by token;
+* **attribution e2e** — a real staggered scheduler run on the tiny
+  engine: ledger counters carry exactly what the tracker carried, every
+  flight record gains a cost block, and per-request ``chip_ms`` sums to
+  the scheduler's busy (prefill + decode) goodput component within 5%;
+* **sentinel** — ``tools/perf_sentinel.py`` exits nonzero on a canned
+  20% tok/s regression, zero on an equal pair, loads all three snapshot
+  schemas, and its ``--self-check`` passes (the tier-1 CI hook).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from dllama_tpu.obs import cost as obs_cost  # noqa: E402
+from dllama_tpu.obs import dispatch as obs_dispatch  # noqa: E402
+from dllama_tpu.obs import flight as obs_flight  # noqa: E402
+from dllama_tpu.obs import metrics as obs_metrics  # noqa: E402
+
+# tiny_config geometry the hand counts below are written against:
+# dim=64, hidden_dim=96, n_layers=2, n_heads=4, n_kv_heads=2, vocab=128
+# -> head_size=16, kv_dim=32.
+#
+# per-layer matmul params: wq+wo (2*64*64=8192) + wk+wv (2*64*32=4096)
+#                          + w1+w2+w3 (3*64*96=18432) = 30720
+# params_per_token = 2 layers * 30720 = 61440;  logits head = 64*128=8192
+PARAMS_PER_TOKEN = 61440
+HEAD_PARAMS = 8192
+# Q40 wire bytes: 18 B per 32 weights
+W_READ_Q40 = 61440 // 32 * 18 + 8192 // 32 * 18  # 34560 + 4608 = 39168
+KV_POS_F32 = 2 * 32 * 4    # (k+v) * kv_dim * 4 B = 256 B/position/layer
+KV_POS_INT8 = 2 * (32 + 4 * 2)  # values + f32 scale planes = 80 B
+
+
+def tiny_cost_model(**over):
+    kw = dict(dim=64, hidden_dim=96, n_layers=2, n_heads=4, n_kv_heads=2,
+              vocab_size=128, weight_codec="q40", kv_codec="kv_f32",
+              kv_el_bytes=4)
+    kw.update(over)
+    return obs_cost.CostModel(**kw)
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --- hand-counted unit costs ----------------------------------------------
+
+def test_prefill_chunk_hand_count():
+    """One 8-token prefill chunk from position 0, single row."""
+    cm = tiny_cost_model()
+    out = cm.dispatch_cost([("prefill", 0, 8)])
+    mm = out["entries"][("q40", "matmul", "prefill")]
+    at = out["entries"][("kv_f32", "attention", "prefill")]
+    # matmuls: 2*8*61440; logits: prefill samples ONE position: 2*1*64*128
+    assert mm["flops"] == 2 * 8 * PARAMS_PER_TOKEN + 2 * 1 * 64 * 128
+    assert mm["flops"] == 999424
+    # weights stream once, one occupied row takes the whole read
+    assert mm["bytes"] == W_READ_Q40 == 39168
+    # attention: 4*dim FLOPs per (query, ctx) pair per layer; ctx lengths
+    # 1..8 sum to 36
+    assert at["flops"] == 4 * 64 * 2 * 36 == 18432
+    # KV: write 8 positions + one block read of the final 8-token context
+    assert at["bytes"] == 8 * 2 * KV_POS_F32 + 8 * 2 * KV_POS_F32 == 8192
+    assert out["flops"] == 999424 + 18432
+    assert out["hbm_bytes"] == 39168 + 8192
+
+
+def test_decode_step_hand_count():
+    """One single-token decode step at cache position 10."""
+    cm = tiny_cost_model()
+    out = cm.dispatch_cost([("decode", 10, 1)])
+    mm = out["entries"][("q40", "matmul", "decode")]
+    at = out["entries"][("kv_f32", "attention", "decode")]
+    assert mm["flops"] == 2 * 1 * PARAMS_PER_TOKEN + 2 * 1 * 64 * 128
+    assert mm["flops"] == 139264
+    assert mm["bytes"] == W_READ_Q40
+    # the new token attends over 11 positions (10 cached + itself)
+    assert at["flops"] == 4 * 64 * 2 * 11 == 5632
+    assert at["bytes"] == 1 * 2 * KV_POS_F32 + 11 * 2 * KV_POS_F32 == 6144
+
+
+def test_paged_int8_decode_hand_count():
+    """Decode over an int8 paged pool: reads round up to whole pages and
+    pay the per-(head, position) scale planes."""
+    cm = tiny_cost_model(kv_codec="kv_int8", kv_el_bytes=1,
+                         paged=True, page_size=16)
+    out = cm.dispatch_cost([("decode", 10, 1)])
+    at = out["entries"][("kv_int8", "paged-decode", "decode")]
+    # context 11 rounds up to one whole 16-position page
+    assert at["bytes"] == 1 * 2 * KV_POS_INT8 + 16 * 2 * KV_POS_INT8
+    assert at["bytes"] == 160 + 2560
+    # attention FLOPs stay at the TRUE context, not the page granularity
+    assert at["flops"] == 4 * 64 * 2 * 11
+
+
+def test_tp2_ring_hop_hand_count():
+    """tp=2: two f32 all-reduces of dim per layer per token, 2*(tp-1)
+    ring hop copies each — tracked on its own path, excluded from HBM."""
+    cm = tiny_cost_model(tp=2)
+    out = cm.dispatch_cost([("decode", 0, 1)])
+    ring = out["entries"][("q40", "tp-ring", "decode")]
+    assert ring["bytes"] == 1 * 2 * 2 * (2 * 1) * 64 * 4 == 2048
+    assert ring["flops"] == 0
+    assert out["hbm_bytes"] == W_READ_Q40 + (
+        out["entries"][("kv_f32", "attention", "decode")]["bytes"])
+    cm1 = tiny_cost_model(tp=1)
+    assert ("q40", "tp-ring", "decode") not in \
+        cm1.dispatch_cost([("decode", 0, 1)])["entries"]
+
+
+def test_decode_burst_rereads_weights_and_context():
+    """A 4-step burst is 4 sequential passes: 4 weight streams, each new
+    token re-reading its whole (growing) context."""
+    cm = tiny_cost_model()
+    out = cm.dispatch_cost([("decode", 4, 4)], steps=4)
+    mm = out["entries"][("q40", "matmul", "decode")]
+    at = out["entries"][("kv_f32", "attention", "decode")]
+    assert mm["bytes"] == 4 * W_READ_Q40
+    # contexts 5,6,7,8: read 26 positions total, write 4
+    assert at["bytes"] == 4 * 2 * KV_POS_F32 + 26 * 2 * KV_POS_F32
+    assert at["flops"] == 4 * 64 * 2 * 26
+    # every decoded position pays the logits head
+    assert mm["flops"] == 2 * 4 * PARAMS_PER_TOKEN + 2 * 4 * 64 * 128
+
+
+def test_mixed_dispatch_splits_weight_read_across_rows():
+    cm = tiny_cost_model()
+    out = cm.dispatch_cost([("prefill", 0, 8), ("decode", 10, 1)])
+    mm_p = out["entries"][("q40", "matmul", "prefill")]
+    mm_d = out["entries"][("q40", "matmul", "decode")]
+    assert mm_p["bytes"] == mm_d["bytes"] == W_READ_Q40 / 2
+    assert out["per_row"][0]["hbm_bytes"] == W_READ_Q40 / 2 + 8192
+    # row totals and entry totals agree
+    assert sum(r["flops"] for r in out["per_row"]) == out["flops"]
+
+
+def test_q8_and_dense_codec_bytes():
+    q8 = tiny_cost_model(weight_codec="q8")
+    assert q8.weight_read_bytes() == (61440 // 32 + 8192 // 32) * 34
+    dense = tiny_cost_model(weight_codec="dense", weight_el_bytes=2)
+    assert dense.weight_read_bytes() == (61440 + 8192) * 2
+
+
+# --- peaks and tracker ----------------------------------------------------
+
+def test_peaks_env_override_and_tpu_table(monkeypatch):
+    monkeypatch.setenv("DLLAMA_PEAK_FLOPS", "1e12")
+    monkeypatch.setenv("DLLAMA_PEAK_BYTES_S", "1e11")
+    obs_cost.reset()
+    p = obs_cost.peaks()
+    assert p["source"] == "env" and p["flops"] == 1e12
+    assert p["bytes_per_s"] == 1e11
+    monkeypatch.delenv("DLLAMA_PEAK_FLOPS")
+    monkeypatch.delenv("DLLAMA_PEAK_BYTES_S")
+    obs_cost.set_backend("TPU v5 lite", "tpu")
+    p = obs_cost.peaks()
+    assert p["source"] == "table"
+    assert p["flops"] == 197e12 and p["bytes_per_s"] == 819e9
+    obs_cost.set_backend(None, None)
+    obs_cost.reset()
+
+
+def test_tracker_mfu_mbu_ratio(monkeypatch):
+    monkeypatch.setenv("DLLAMA_PEAK_FLOPS", "1e9")
+    monkeypatch.setenv("DLLAMA_PEAK_BYTES_S", "1e9")
+    obs_cost.reset()
+    tr = obs_cost.PerfTracker()
+    # 5e8 FLOPs + 2.5e8 bytes over 1000 ms against 1e9/s peaks
+    tr.note(5e8, 2.5e8, 1000.0)
+    assert tr.mfu() == pytest.approx(0.5)
+    assert tr.mbu() == pytest.approx(0.25)
+    snap = tr.snapshot()
+    assert snap["flops_total"] == 5e8 and snap["chip_wall_ms"] == 1000.0
+    obs_cost.reset()
+
+
+# --- scheduler attribution e2e --------------------------------------------
+
+@pytest.fixture
+def clean_obs(monkeypatch):
+    # deterministic peaks: MFU/MBU must be computable without the CPU
+    # microbenchmark's noise
+    monkeypatch.setenv("DLLAMA_PEAK_FLOPS", "1e12")
+    monkeypatch.setenv("DLLAMA_PEAK_BYTES_S", "1e11")
+    obs_dispatch.reset()
+    obs_flight.clear()
+    obs_metrics.SCHED_STEP_TIME_MS.reset()
+    obs_cost.reset()
+    yield
+    obs_dispatch.reset()
+    obs_flight.clear()
+    obs_metrics.SCHED_STEP_TIME_MS.reset()
+    obs_cost.reset()
+
+
+def _run_staggered(slots=4, max_new=24):
+    import jax
+
+    from dllama_tpu.models.config import tiny_config
+    from dllama_tpu.models.params import init_params
+    from dllama_tpu.parallel.mesh import make_mesh
+    from dllama_tpu.runtime.engine import Engine
+    from dllama_tpu.runtime.scheduler import SlotScheduler
+    import time as _time
+
+    from dllama_tpu.obs.log import request_id_var
+
+    cfg = tiny_config(seq_len=64)
+    eng = Engine(cfg, init_params(cfg, seed=4),
+                 mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+                 batch=slots)
+    sched = SlotScheduler(eng, prefill_chunk=4, max_wait_ms=20.0,
+                          decode_burst=4)
+    prompts = [[5, 9, 2], [7, 3, 11, 4, 6], [2, 4, 6], [9, 8, 7, 6]]
+    rids = [f"cost-e2e-{i}" for i in range(slots)]
+
+    def run(i, delay):
+        _time.sleep(delay)
+        # the submitting thread's request id rides the ticket into the
+        # flight record (same seam the HTTP handler uses)
+        request_id_var.set(rids[i])
+        t = sched.submit(prompts[i], max_new)
+        for _ in t.tokens():
+            pass
+
+    ths = [threading.Thread(target=run, args=(i, 0.03 * i))
+           for i in range(slots)]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join()
+    sched.close()
+    return rids
+
+
+def test_scheduler_attribution_e2e(clean_obs):
+    rids = _run_staggered()
+
+    # every request's flight record gained a full cost block
+    costs = {}
+    for rid in rids:
+        rec = obs_flight.get(rid)
+        assert rec is not None and "cost" in rec, rid
+        for k in ("chip_ms", "flops", "hbm_bytes", "kv_page_ms"):
+            assert k in rec["cost"]
+        assert rec["cost"]["flops"] > 0 and rec["cost"]["chip_ms"] > 0
+        costs[rid] = rec["cost"]
+
+    # ledger counters hold exactly what the tracker accumulated
+    # (json keys are "codec/path/phase")
+    snap = obs_cost.TRACKER.snapshot()
+    flops_by_key = obs_metrics.DISPATCH_FLOPS.json_value()
+    bytes_by_key = obs_metrics.DISPATCH_BYTES.json_value()
+    ledger_flops = sum(flops_by_key.values())
+    assert ledger_flops == pytest.approx(snap["flops_total"], rel=1e-9)
+    ledger_hbm = sum(v for k, v in bytes_by_key.items()
+                     if k.split("/")[1] != "tp-ring")
+    assert ledger_hbm == pytest.approx(snap["hbm_bytes_total"], rel=1e-9)
+    # tp=1: no ring entries at all
+    assert not any(k.split("/")[1] == "tp-ring" for k in bytes_by_key)
+    # phases seen: both prefill and decode attributed
+    phases = {k.split("/")[2] for k in flops_by_key}
+    assert {"prefill", "decode"} <= phases
+
+    # per-request chip_ms telescopes to the busy goodput component
+    comp = obs_metrics.SCHED_STEP_TIME_MS.json_value()
+    busy = comp.get("prefill", 0.0) + comp.get("decode", 0.0)
+    attributed = sum(c["chip_ms"] for c in costs.values())
+    assert busy > 0
+    assert attributed == pytest.approx(busy, rel=0.05)
+
+    # per-class chip time saw the same milliseconds (default class)
+    by_class = obs_metrics.CLASS_CHIP_MS.json_value()
+    assert sum(by_class.values()) == pytest.approx(attributed, rel=0.05)
+    assert "standard" in by_class
+
+    # MFU/MBU gauges set and present in BOTH expositions
+    assert obs_metrics.MFU.value > 0 and obs_metrics.MBU.value > 0
+    js = obs_metrics.snapshot_json()
+    assert js["mfu"] > 0 and js["mbu"] > 0
+    txt = obs_metrics.render_prometheus()
+    assert "dllama_mfu" in txt and "dllama_mbu" in txt
+    assert "dllama_dispatch_flops_total" in txt
+    assert "dllama_class_chip_ms_total" in txt
+
+    # /health perf block carries the same summary
+    perf = obs_cost.summary()
+    assert perf["flops_total"] == snap["flops_total"]
+    assert perf["mfu"] is not None and perf["peaks"]["source"] == "env"
+    assert perf["chip_ms_by_class"]
+
+
+def test_model_from_engine_sniffs_codecs(clean_obs):
+    import jax
+
+    from dllama_tpu.models.config import tiny_config
+    from dllama_tpu.models.params import init_params
+    from dllama_tpu.parallel.mesh import make_mesh
+    from dllama_tpu.runtime.engine import Engine
+
+    cfg = tiny_config(seq_len=64)
+    eng = Engine(cfg, init_params(cfg, seed=4),
+                 mesh=make_mesh(tp=1, devices=jax.devices()[:1]), batch=2)
+    cm = obs_cost.model_from_engine(eng)
+    assert cm is not None
+    assert cm.params_per_token == PARAMS_PER_TOKEN
+    assert cm.tp == 1 and not cm.paged
+    # an unmodelable engine degrades to None, never raises
+    assert obs_cost.model_from_engine(object()) is None
+
+
+# --- perf sentinel --------------------------------------------------------
+
+def _result(value, extras=None):
+    return {"metric": "tiny decode tok/s", "value": value, "unit": "tok/s",
+            "vs_baseline": None, **({"extras": extras} if extras else {})}
+
+
+def test_sentinel_regression_and_clean_pair(tmp_path, capsys):
+    ps = _load_tool("perf_sentinel")
+    base = tmp_path / "base.json"
+    slow = tmp_path / "slow.json"
+    same = tmp_path / "same.json"
+    base.write_text(json.dumps(_result(100.0)))
+    slow.write_text(json.dumps(_result(80.0)))   # 20% tok/s drop
+    same.write_text(json.dumps(_result(100.0)))
+    assert ps.main([str(base), str(slow)]) == 1
+    assert "regression" in capsys.readouterr().out.lower()
+    assert ps.main([str(base), str(same), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["verdict"] == "ok" and rep["regressions"] == []
+
+
+def test_sentinel_loads_driver_wrapper_and_jsonl(tmp_path):
+    ps = _load_tool("perf_sentinel")
+    # driver wrapper (BENCH_r*.json shape): result rides in "parsed"
+    wrapper = tmp_path / "BENCH_r98.json"
+    wrapper.write_text(json.dumps(
+        {"n": 98, "cmd": "bench", "rc": 0, "tail": "noise",
+         "parsed": _result(50.0, {"cpu_sched4_agg_toks": 40.0})}))
+    flat = ps.load_any(str(wrapper))
+    assert flat == {"value": 50.0, "cpu_sched4_agg_toks": 40.0}
+    # stage-snapshot JSONL: keys are stage:metric, histograms -> _avg
+    jl = tmp_path / "BENCH_metrics.jsonl"
+    jl.write_text(json.dumps(
+        {"stage": "cpu-tiny-sched4", "ts": 1.0, "schema_version": 2,
+         "metrics": {"schema_version": 2, "sched_goodput_ratio": 0.9,
+                     "mfu": 0.25,
+                     "ttft_seconds": {"count": 2, "sum": 0.4, "avg": 0.2,
+                                      "buckets": {}}}}) + "\n")
+    flat = ps.load_any(str(jl))
+    assert flat["cpu-tiny-sched4:sched_goodput_ratio"] == 0.9
+    assert flat["cpu-tiny-sched4:mfu"] == 0.25
+    assert flat["cpu-tiny-sched4:ttft_seconds_avg"] == 0.2
+    # direction map: latency is lower-better, throughput higher-better
+    assert ps.direction_of("x:ttft_seconds_avg") == "lower"
+    assert ps.direction_of("cpu_sched4_agg_toks") == "higher"
+    assert ps.direction_of("mfu") == "higher"
+
+
+def test_sentinel_self_check_fast():
+    """The tier-1 CI hook: --self-check must pass without touching the
+    filesystem or network."""
+    ps = _load_tool("perf_sentinel")
+    assert ps.self_check() == 0
+    assert ps.main(["--self-check"]) == 0
+
+
+def test_bench_stamps_metrics_bank(tmp_path, monkeypatch):
+    """Satellite: every banked stage row carries schema_version, the
+    bench run id, and the git SHA."""
+    bank = tmp_path / "bank.jsonl"
+    monkeypatch.setenv("BENCH_METRICS_BANK", str(bank))
+    monkeypatch.setenv("BENCH_RUN_ID", "testrun-1")
+    monkeypatch.setenv("BENCH_GIT_SHA", "abc1234")
+    sys.path.insert(0, REPO)
+    import bench
+    bench._bank_stage_metrics("unit-stage")
+    row = json.loads(bank.read_text().strip())
+    assert row["stage"] == "unit-stage"
+    assert row["schema_version"] == row["metrics"]["schema_version"]
+    assert row["bench_run_id"] == "testrun-1"
+    assert row["git_sha"] == "abc1234"
+
+
+def test_bench_vs_baseline_helper():
+    sys.path.insert(0, REPO)
+    import bench
+    assert bench._vs_baseline(19.64, 9.82) == 2.0
+    assert bench._vs_baseline(19.64, None) is None
+    assert bench._vs_baseline(None, 9.82) is None
+    assert bench._vs_baseline(5.0, 0) is None

@@ -59,6 +59,18 @@ def _max_burn(snap: dict) -> float | None:
     return None
 
 
+def _chip_rate(snap: dict) -> float | None:
+    """Chip-ms attributed per second of uptime (``class_chip_ms`` is a
+    labeled-counter JSON dict keyed by QoS class) — how much actual
+    chip-time this replica hands out per wall second."""
+    cc = snap.get("class_chip_ms")
+    up = _num(snap, "uptime_s")
+    if not isinstance(cc, dict) or not up:
+        return None
+    vals = [v for v in cc.values() if isinstance(v, (int, float))]
+    return sum(vals) / up if vals else None
+
+
 def replica_rows(health: dict | None, fed: dict | None) -> list[dict]:
     """One row per replica: registry status joined with its federated
     metrics snapshot (stale snapshots render with a ``~`` marker)."""
@@ -89,6 +101,8 @@ def replica_rows(health: dict | None, fed: dict | None) -> list[dict]:
             "kv_used": _num(snap, "kv_pages_in_use"),
             "kv_total": _num(snap, "kv_pages_total"),
             "goodput": _num(snap, "sched_goodput_ratio"),
+            "mfu": _num(snap, "mfu"),
+            "chip_rate": _chip_rate(snap),
             "burn": _max_burn(snap),
             "served": _num(snap, "requests_served"),
         })
@@ -102,9 +116,10 @@ def _fmt(v, spec: str = "", dash: str = "-") -> str:
     return format(v, spec)
 
 
-def format_rows(rows: list[dict]) -> list[str]:
+def format_rows(rows: list[dict], perf: dict | None = None) -> list[str]:
     hdr = (f"{'replica':<22} {'state':<9} {'slots':>5} {'queue':>5} "
-           f"{'kv%':>6} {'goodput':>7} {'burn':>6} {'served':>8}")
+           f"{'kv%':>6} {'goodput':>7} {'mfu':>6} {'chms/s':>7} "
+           f"{'burn':>6} {'served':>8}")
     out = [hdr, "-" * len(hdr)]
     for r in rows:
         kv = None
@@ -115,8 +130,29 @@ def format_rows(rows: list[dict]) -> list[str]:
             f"{r['addr']:<22} {mark + r['state']:<9} "
             f"{_fmt(r['slots'], '.0f'):>5} {_fmt(r['queue'], '.0f'):>5} "
             f"{_fmt(kv, '.1f'):>6} {_fmt(r['goodput'], '.3f'):>7} "
+            f"{_fmt(r.get('mfu'), '.3f'):>6} "
+            f"{_fmt(r.get('chip_rate'), '.1f'):>7} "
             f"{_fmt(r['burn'], '.2f'):>6} {_fmt(r['served'], '.0f'):>8}")
+    footer = fleet_footer(perf)
+    if footer:
+        out.append(footer)
     return out
+
+
+def fleet_footer(perf: dict | None) -> str | None:
+    """One fleet-total line under the table: chip-time share by QoS
+    class plus the fleet-mean MFU (the router's ``perf`` rollup in the
+    federated JSON; older routers without it get no footer)."""
+    if not perf:
+        return None
+    shares = perf.get("class_chip_share") or {}
+    parts = [f"{cls}={shares[cls]:.0%}" for cls in sorted(shares)]
+    mfu = perf.get("mfu_mean")
+    if mfu is not None:
+        parts.append(f"mfu~{mfu:.3f}")
+    if not parts:
+        return None
+    return "fleet chip-time: " + " ".join(parts)
 
 
 def format_event(src: str, ev: dict) -> str:
@@ -174,7 +210,7 @@ def render_plain(base: str, snap: dict, tail: EventTail,
             f"{health.get('total', '?')}  "
             f"model={health.get('model', '?')}")
     lines = [head, ""]
-    lines += format_rows(snap["rows"])
+    lines += format_rows(snap["rows"], (snap["fed"] or {}).get("perf"))
     ev = tail.tail(events_n)
     if ev:
         lines += ["", "events:"] + [f"  {line}" for line in ev]

@@ -1,0 +1,312 @@
+#!/usr/bin/env python3
+"""Performance-regression sentinel over banked bench evidence.
+
+Loads any two performance snapshots — in any mix of the three formats
+the repo already produces — normalizes them into one flat
+``{metric: value}`` schema, and renders a direction-aware verdict table:
+
+* ``BENCH_r*.json``      — a banked round (the driver's wrapper with its
+                           ``parsed`` result, a raw result line, or the
+                           result embedded in ``tail``)
+* ``BENCH_metrics.jsonl`` — per-stage registry snapshots
+                           (``_bank_stage_metrics``), keyed ``stage:metric``
+* ``http://...``          — a live ``/metrics`` or ``/metrics?scope=fleet``
+                           scrape
+
+A metric regresses when it moved in its *bad* direction by more than
+``--threshold`` (relative).  tok/s down 20% is a regression; latency-ms
+down 20% is an improvement; metrics whose direction is unknown are shown
+but never gate.  Exit code: 0 clean, 1 regression, 2 load/usage error.
+
+Usage:
+    python tools/perf_sentinel.py BENCH_r05.json BENCH_r06.json
+    python tools/perf_sentinel.py old_metrics.jsonl BENCH_metrics.jsonl
+    python tools/perf_sentinel.py BENCH_r06.json http://127.0.0.1:8080/metrics?scope=fleet
+    python tools/perf_sentinel.py --self-check
+
+``bench.py`` calls :func:`compare` as a library at the end of every run
+(previous banked round vs the fresh result) and records the verdict in
+the result's ``extras`` — evidence, never a gate there.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+#: relative move in the bad direction beyond which a metric regresses
+DEFAULT_THRESHOLD = 0.10
+
+# direction classification by key substring, first match wins (checked
+# against the lowercased final key segment).  "lower" = smaller is
+# better (latency, waste); "higher" = bigger is better (throughput,
+# utilization, acceptance).
+_LOWER_HINTS = ("_ms", "ms_", "host_gap", "gap_share", "share", "spill",
+                "queued", "burn", "wait", "latency", "ttft", "itl",
+                "recompile", "degrade", "errors", "preempt")
+_HIGHER_HINTS = ("toks", "tok_s", "speedup", "goodput", "mfu", "mbu",
+                 "accept", "ratio", "throughput", "served", "reused",
+                 "hit", "value")
+
+
+def direction_of(key: str) -> str:
+    """'higher' / 'lower' / 'unknown' — which way is good for ``key``."""
+    leaf = key.rsplit(":", 1)[-1].lower()
+    for hint in _LOWER_HINTS:
+        if hint in leaf:
+            return "lower"
+    for hint in _HIGHER_HINTS:
+        if hint in leaf:
+            return "higher"
+    return "unknown"
+
+
+# --- normalizers (each returns a flat {key: float}) -----------------------
+
+def normalize_result(doc: dict) -> dict:
+    """One bench result line ({"metric", "value", "unit", "extras"}):
+    the headline rides as ``value`` (unit-checked), extras ride by key."""
+    out = {}
+    v = doc.get("value")
+    if isinstance(v, (int, float)) and "tok" in str(doc.get("unit", "")):
+        out["value"] = float(v)
+    for k, x in (doc.get("extras") or {}).items():
+        if isinstance(x, (int, float)) and not isinstance(x, bool):
+            out[str(k)] = float(x)
+    return out
+
+
+def _registry_scalars(snap: dict, prefix: str = "") -> dict:
+    """The comparable scalars of one registry snapshot: plain-number
+    gauges/counters plus histogram averages (``<name>_avg``).  Label
+    dicts are skipped — their keysets churn across runs."""
+    skip = {"schema_version", "uptime_s", "ts", "bench_run_id", "git_sha"}
+    out = {}
+    for k, v in (snap or {}).items():
+        if k in skip:
+            continue
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[prefix + k] = float(v)
+        elif isinstance(v, dict) and "avg" in v and "count" in v:
+            if v["count"]:
+                out[prefix + k + "_avg"] = float(v["avg"])
+    return out
+
+
+def normalize_stage_lines(lines) -> dict:
+    """BENCH_metrics.jsonl → ``{"<stage>:<metric>": value}``; a stage
+    appearing twice keeps its last snapshot (rerun wins)."""
+    out = {}
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        stage = row.get("stage", "?")
+        scalars = _registry_scalars(row.get("metrics") or {},
+                                    prefix=f"{stage}:")
+        # last write wins per stage: drop that stage's previous keys
+        out = {k: v for k, v in out.items()
+               if not k.startswith(f"{stage}:")}
+        out.update(scalars)
+    return out
+
+
+def normalize_fleet(doc: dict) -> dict:
+    """A ``/metrics?scope=fleet`` document: the router's perf rollup
+    plus every up replica's registry scalars keyed by address."""
+    out = {}
+    for k, v in (doc.get("perf") or {}).items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[f"fleet:{k}"] = float(v)
+    for addr, entry in (doc.get("replicas") or {}).items():
+        if entry.get("up") or entry.get("metrics"):
+            out.update(_registry_scalars(entry.get("metrics") or {},
+                                         prefix=f"{addr}:"))
+    return out
+
+
+def normalize(doc) -> dict:
+    """Dispatch on document shape (one already-parsed JSON value)."""
+    if isinstance(doc, list):
+        return normalize_stage_lines(json.dumps(r) for r in doc)
+    if not isinstance(doc, dict):
+        raise ValueError("unrecognized snapshot shape")
+    if "replicas" in doc:
+        return normalize_fleet(doc)
+    if "metric" in doc and "value" in doc:
+        return normalize_result(doc)
+    if "schema_version" in doc:
+        return _registry_scalars(doc)
+    # driver wrapper around a bench round: prefer the parsed result,
+    # else fish the last result-looking JSON line out of the tail
+    if "parsed" in doc and isinstance(doc["parsed"], dict):
+        return normalize_result(doc["parsed"])
+    if "tail" in doc:
+        for line in reversed(str(doc["tail"]).splitlines()):
+            line = line.strip()
+            i = line.find('{"metric"')
+            if i < 0:
+                continue
+            try:
+                return normalize_result(json.loads(line[i:]))
+            except ValueError:
+                continue
+    raise ValueError("unrecognized snapshot shape")
+
+
+def load_any(src: str) -> dict:
+    """Normalize a path or URL into the flat schema."""
+    if src.startswith(("http://", "https://")):
+        with urllib.request.urlopen(src, timeout=10) as r:
+            return normalize(json.loads(r.read().decode("utf-8")))
+    with open(src) as f:
+        text = f.read()
+    if src.endswith(".jsonl"):
+        return normalize_stage_lines(text.splitlines())
+    doc = json.loads(text)
+    return normalize(doc)
+
+
+# --- comparison -----------------------------------------------------------
+
+def compare(base: dict, cur: dict,
+            threshold: float = DEFAULT_THRESHOLD) -> dict:
+    """Pairwise verdict over the metrics both snapshots report."""
+    rows = []
+    for key in sorted(set(base) & set(cur)):
+        b, c = base[key], cur[key]
+        d = direction_of(key)
+        if b == 0:
+            delta = 0.0 if c == 0 else None
+        else:
+            delta = (c - b) / abs(b)
+        status = "n/a"
+        if delta is not None and d != "unknown":
+            bad = -delta if d == "higher" else delta
+            if bad > threshold:
+                status = "regression"
+            elif bad < -threshold:
+                status = "improvement"
+            else:
+                status = "ok"
+        elif delta is not None:
+            status = "info"
+        rows.append({"metric": key, "base": b, "cur": c,
+                     "delta_pct": round(delta * 100, 2)
+                     if delta is not None else None,
+                     "direction": d, "status": status})
+    regressions = [r["metric"] for r in rows if r["status"] == "regression"]
+    return {"verdict": "regression" if regressions else "ok",
+            "threshold": threshold, "compared": len(rows),
+            "regressions": regressions, "metrics": rows}
+
+
+def render_table(report: dict) -> str:
+    lines = [f"{'metric':<48} {'base':>12} {'cur':>12} "
+             f"{'delta':>8} {'dir':<7} status",
+             "-" * 96]
+    for r in report["metrics"]:
+        delta = f"{r['delta_pct']:+.1f}%" if r["delta_pct"] is not None \
+            else "-"
+        lines.append(f"{r['metric']:<48.48} {r['base']:>12.4g} "
+                     f"{r['cur']:>12.4g} {delta:>8} "
+                     f"{r['direction']:<7} {r['status']}")
+    lines.append(f"verdict: {report['verdict'].upper()} "
+                 f"({len(report['regressions'])} regression(s) over "
+                 f"{report['compared']} comparable metric(s), "
+                 f"threshold {report['threshold']:.0%})")
+    return "\n".join(lines)
+
+
+# --- self-check -----------------------------------------------------------
+
+def self_check() -> int:
+    """Canned-fixture verdicts: the schema normalizers and the
+    direction-aware comparison, no filesystem or network."""
+    base = normalize_result({
+        "metric": "tiny decode tok/s", "value": 100.0, "unit": "tok/s",
+        "extras": {"sched4_agg_toks": 50.0, "host_gap_share": 0.10}})
+    slower = normalize_result({
+        "metric": "tiny decode tok/s", "value": 80.0, "unit": "tok/s",
+        "extras": {"sched4_agg_toks": 50.0, "host_gap_share": 0.10}})
+    checks = [
+        ("result schema", set(base) ==
+         {"value", "sched4_agg_toks", "host_gap_share"}),
+        ("20% tok/s drop regresses",
+         compare(base, slower)["verdict"] == "regression"),
+        ("equal pair is clean",
+         compare(base, dict(base))["verdict"] == "ok"),
+        ("latency drop is improvement",
+         compare({"ttft_seconds_avg": 0.2}, {"ttft_seconds_avg": 0.1})
+         ["verdict"] == "ok"),
+        ("latency jump regresses",
+         compare({"ttft_seconds_avg": 0.1}, {"ttft_seconds_avg": 0.2})
+         ["verdict"] == "regression"),
+    ]
+    stage = normalize_stage_lines([json.dumps(
+        {"stage": "cpu-tiny-sched4", "ts": 1.0,
+         "metrics": {"schema_version": 2, "sched_goodput_ratio": 0.9,
+                     "mfu": 0.2,
+                     "ttft_seconds": {"count": 3, "sum": 0.3, "avg": 0.1,
+                                      "buckets": {}}}})])
+    checks.append(("jsonl schema", stage == {
+        "cpu-tiny-sched4:sched_goodput_ratio": 0.9,
+        "cpu-tiny-sched4:mfu": 0.2,
+        "cpu-tiny-sched4:ttft_seconds_avg": 0.1}))
+    fleet = normalize_fleet({
+        "perf": {"mfu_mean": 0.25, "mbu_mean": None},
+        "replicas": {"127.0.0.1:1": {"up": True, "metrics": {
+            "schema_version": 2, "requests_served": 7}}}})
+    checks.append(("fleet schema", fleet == {
+        "fleet:mfu_mean": 0.25, "127.0.0.1:1:requests_served": 7.0}))
+    ok = True
+    for name, passed in checks:
+        print(f"self-check: {name}: {'ok' if passed else 'FAIL'}")
+        ok = ok and passed
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("base", nargs="?",
+                    help="baseline snapshot (path or URL)")
+    ap.add_argument("current", nargs="?",
+                    help="current snapshot (path or URL)")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="relative bad-direction move that regresses "
+                         "(default 0.10)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report")
+    ap.add_argument("--self-check", action="store_true",
+                    help="run the canned-fixture schema/verdict checks")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        return self_check()
+    if not args.base or not args.current:
+        ap.error("need BASE and CURRENT snapshots (or --self-check)")
+    try:
+        base = load_any(args.base)
+        cur = load_any(args.current)
+    except Exception as e:
+        print(f"perf_sentinel: load failed: {e}", file=sys.stderr)
+        return 2
+    report = compare(base, cur, threshold=args.threshold)
+    if not report["compared"]:
+        print("perf_sentinel: no comparable metrics between the two "
+              "snapshots", file=sys.stderr)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render_table(report))
+    return 1 if report["verdict"] == "regression" else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
