@@ -794,6 +794,116 @@ def _bench_sched_overlap(cfg, slots=4, max_new=96):
     return {"sync": run_mode(False), "overlap": run_mode(True)}
 
 
+def _bench_sched_fused(cfg, slots=4, max_new=96):
+    """One-dispatch-decode A/B (the fused page-walk attention kernel in
+    ops/attention.py + on-device sampling): the ``-sched4`` pure-decode
+    workload on a paged pool, run twice — fused attention off, then
+    forced on (``DLLAMA_FUSED_ATTN=on`` on TPU, ``interp`` elsewhere so
+    the kernel logic still executes) — each on a fresh engine +
+    scheduler, because the env ladder is read lazily at trace time and
+    the engine's compile keys include it.  Greedy decode must be
+    byte-identical across modes (checked on the emitted streams), so
+    the tok/s delta is pure kernel-fusion effect.  The headline signal
+    is the dispatch-family count per steady pure-decode step, taken
+    from a trace-time ledger probe: reset the ledger on the fresh
+    engine, trace one t=1 slot_step, and count the distinct matmul
+    (``q40/``/``q8/``) + attention (``kv_``) families it recorded —
+    the fused contract is ≤ 2 (one matmul family + ``paged-fused``),
+    the unfused gather arm records 3–4.  Returns per-mode dicts plus
+    the cross-mode parity verdict."""
+    import threading
+
+    import jax
+    import numpy as np
+    from dllama_tpu.obs import dispatch as obs_dispatch
+    from dllama_tpu.parallel.mesh import make_mesh
+    from dllama_tpu.runtime.engine import Engine
+    from dllama_tpu.runtime.scheduler import SlotScheduler
+
+    params = maybe_blocked(_zero_q40_params(cfg))
+    page_size = 16
+    rng = np.random.RandomState(7)
+    prompts = [[int(t) for t in rng.randint(1, cfg.vocab_size, 8)]
+               for _ in range(slots)]
+    kv_pages = sum(-(-min(len(p) + max_new, cfg.seq_len) // page_size)
+                   for p in prompts) + 1
+    fused_env = "on" if jax.default_backend() == "tpu" else "interp"
+
+    def run_mode(fused):
+        os.environ["DLLAMA_FUSED_ATTN"] = fused_env if fused else "off"
+        tag = f"fused={fused_env}" if fused else "fused=off"
+        eng = Engine(cfg, params,
+                     mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+                     batch=slots,
+                     kv_pages=kv_pages, kv_page_size=page_size)
+        # dispatch-family probe first, on the fresh engine: the ledger
+        # records once per compiled call site (trace time), so reset and
+        # trace exactly one steady pure-decode executable — a t=1 greedy
+        # slot_step over a small page table — and count what it recorded
+        obs_dispatch.reset()
+        maxp = 2
+        ptab = 1 + np.arange(slots * maxp, dtype=np.int32).reshape(
+            slots, maxp)
+        eng.slot_step(np.ones((slots, 1), np.int32),
+                      np.full((slots,), page_size + 1, np.int32),
+                      np.ones((slots,), np.int32),
+                      temps_np=np.zeros((slots,), np.float32),
+                      topps_np=np.full((slots,), 0.9, np.float32),
+                      page_tables_np=ptab)
+        fams = sorted(k for k in obs_dispatch.dispatches()
+                      if k.startswith(("q40/", "q80/", "q8/", "kv_")))
+        print(f"bench: sched-fused {tag} steady-decode dispatch "
+              f"families ({len(fams)}): {' '.join(fams)}", file=sys.stderr)
+
+        sched = SlotScheduler(eng, prefill_chunk=16, max_wait_ms=20.0)
+        streams = [None] * slots
+
+        def run(i):
+            t = sched.submit(prompts[i], max_new)
+            streams[i] = list(t.tokens())
+
+        def wave():
+            ths = [threading.Thread(target=run, args=(i,))
+                   for i in range(slots)]
+            t0 = time.perf_counter()
+            for th in ths:
+                th.start()
+            for th in ths:
+                th.join()
+            return time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        wave()  # compile + warmup: identical shape set
+        print(f"compile+warmup ({tag}): {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
+        elapsed = wave()
+        sched.close()
+        mode = {
+            "toks": sum(len(s) for s in streams) / elapsed,
+            "dispatches_per_step": len(fams),
+            "families": fams,
+            "streams": streams,
+        }
+        print(f"bench: sched-fused {tag}: {mode['toks']:.1f} tok/s, "
+              f"{len(fams)} dispatch families/step", file=sys.stderr)
+        return mode
+
+    prev = os.environ.get("DLLAMA_FUSED_ATTN")
+    try:
+        off = run_mode(False)
+        on = run_mode(True)
+    finally:
+        if prev is None:
+            os.environ.pop("DLLAMA_FUSED_ATTN", None)
+        else:
+            os.environ["DLLAMA_FUSED_ATTN"] = prev
+    parity = on.pop("streams") == off.pop("streams")
+    if not parity:
+        print("bench: sched-fused GREEDY STREAM MISMATCH between modes",
+              file=sys.stderr)
+    return {"fused": on, "unfused": off, "parity": parity}
+
+
 def _bench_sched_spec(cfg, slots=4, max_new=96, spec_k=4):
     """Speculative-decoding A/B (runtime/spec.py + the slot-verify
     dispatch): the ``-sched4`` staggered workload run twice, speculation
@@ -1001,6 +1111,42 @@ def _attempt_body(name):
             if on["accept_ratio"] is not None else None,
             "drafts_proposed": on["proposed"],
             "drafts_accepted": on["accepted"],
+            "backend": jax.default_backend()}))
+        return
+
+    if name.endswith("-fused4"):
+        # one-dispatch decode (ops/attention.py fused page-walk kernel +
+        # runtime/decode_loop.py on-device sampling): the -sched4
+        # pure-decode workload on a paged pool, fused attention off vs
+        # forced on — greedy streams must be byte-identical, so the
+        # tok/s delta is pure fusion; the trace-time ledger probe counts
+        # matmul+attention dispatch families per steady decode step
+        # (fused contract: ≤ 2, the unfused gather arm records 3–4)
+        base = name[:-7]
+        cfg = _model_cfg(base)
+        if base == "cpu-tiny":
+            impl = "xla"
+        else:
+            print(f"bench: {base}: claiming backend...", file=sys.stderr)
+            print(f"bench: {base}: backend {jax.default_backend()}",
+                  file=sys.stderr)
+            impl = _pallas_hw_check("q40")
+        ab = _bench_sched_fused(cfg.with_(quant_impl=impl))
+        on, off = ab["fused"], ab["unfused"]
+        print(json.dumps({
+            "metric": f"{base} q40 fused-attention one-dispatch decode "
+                      f"slots=4 pure-decode aggregate tok/s (paged pool, "
+                      f"{impl})",
+            "value": round(on["toks"], 2), "unit": "tok/s",
+            "vs_baseline": _vs_baseline(
+                on["toks"], BASELINE_7B_TOKS if base == "llama2-7b" else None),
+            "unfused_toks": round(off["toks"], 2),
+            "fused_speedup": round(on["toks"] / off["toks"], 3)
+            if off["toks"] else None,
+            "dispatches_per_step": on["dispatches_per_step"],
+            "unfused_dispatches_per_step": off["dispatches_per_step"],
+            "dispatch_families": on["families"],
+            "greedy_parity": ab["parity"],
             "backend": jax.default_backend()}))
         return
 
@@ -1676,6 +1822,24 @@ def main():
                     ov_out.get("host_gap_share_off")
                 print(f"bench: overlapped dispatch: {json.dumps(ov_out)}",
                       file=sys.stderr)
+        # one-dispatch-decode evidence: the sched4 pure-decode workload
+        # on a paged pool with the fused page-walk attention kernel off
+        # vs on — on hardware the gather arm's extra dispatches are real
+        # HBM round trips, so the family-count drop converts to tok/s
+        if got_7b and remaining() > RESERVE + 280 and _relay_up():
+            fu_out = _spawn("llama2-7b-fused4", 300)
+            if fu_out:
+                extras["llama2-7b_fused4_agg_toks"] = fu_out["value"]
+                extras["llama2-7b_fused4_unfused_toks"] = \
+                    fu_out.get("unfused_toks")
+                extras["llama2-7b_fused4_speedup"] = \
+                    fu_out.get("fused_speedup")
+                extras["llama2-7b_fused4_dispatches_per_step"] = \
+                    fu_out.get("dispatches_per_step")
+                extras["llama2-7b_fused4_greedy_parity"] = \
+                    fu_out.get("greedy_parity")
+                print(f"bench: one-dispatch decode: {json.dumps(fu_out)}",
+                      file=sys.stderr)
         # speculative-decoding evidence: the sched4 workload with
         # prompt-lookup drafts off vs on — on hardware each accepted
         # draft saves a whole dispatch round trip, so the accept ratio
@@ -1844,10 +2008,28 @@ def main():
                           "cpu_batch8_vs_single": round(
                               b8["value"] / out["value"], 2)}
         if remaining() > 140:
+            # one-dispatch-decode A/B on the same CPU backend (fused
+            # kernel forced via interpret mode): tok/s parity is the
+            # expected result here — the signal is the dispatch-family
+            # count per steady decode step (fused contract: ≤ 2 vs the
+            # gather arm's 3–4) and byte-identical greedy streams.
+            # Runs FIRST among the scheduler stages: it is this round's
+            # new evidence, so a tight tail starves the older rows.
+            fu = _spawn("cpu-tiny-fused4", min(remaining() - 60, 360),
+                        env_extra=cpu_env)
+            if fu and fu.get("value"):
+                extras = extras or {}
+                extras["cpu_fused4_agg_toks"] = fu["value"]
+                extras["cpu_fused4_unfused_toks"] = fu.get("unfused_toks")
+                extras["cpu_fused4_dispatches_per_step"] = \
+                    fu.get("dispatches_per_step")
+                extras["cpu_fused4_unfused_dispatches_per_step"] = \
+                    fu.get("unfused_dispatches_per_step")
+                extras["cpu_fused4_greedy_parity"] = \
+                    fu.get("greedy_parity")
+        if remaining() > 140:
             # overlapped-dispatch A/B on the same CPU backend: pure-decode
-            # steady state with the two-deep pipeline off vs on.  Runs
-            # FIRST among the scheduler stages: it is this round's new
-            # evidence, so a tight tail starves the older rows instead.
+            # steady state with the two-deep pipeline off vs on.
             # (The CPU client executes at enqueue time, so tok/s parity
             # is the expected result here; the exposed-host_gap drop is
             # the pipeline signal.)

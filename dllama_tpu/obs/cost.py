@@ -238,7 +238,8 @@ class CostModel:
                  weight_codec: str = "dense", weight_el_bytes: int = 2,
                  kv_codec: str = "kv_f32", kv_el_bytes: int = 4,
                  tp: int = 1, paged: bool = False, page_size: int = 0,
-                 n_experts: int = 0, n_active_experts: int = 0):
+                 n_experts: int = 0, n_active_experts: int = 0,
+                 fused: bool = False):
         self.dim = dim
         self.hidden_dim = hidden_dim
         self.n_layers = n_layers
@@ -254,6 +255,10 @@ class CostModel:
         self.tp = max(1, int(tp))
         self.paged = paged
         self.page_size = int(page_size or 0)
+        #: decode attention runs the fused page-walk Pallas kernel (one
+        #: attention-family dispatch; same FLOPs/bytes, different family
+        #: so MFU/MBU attribution matches the ledger path)
+        self.fused = bool(fused)
         self.moe = n_experts > 0
         self.n_active_experts = n_active_experts
 
@@ -349,7 +354,9 @@ class CostModel:
     def attn_path(self, phase: str) -> str:
         if not self.paged:
             return "attention"
-        return "paged-decode" if phase == "decode" else "paged-gather"
+        if phase == "decode":
+            return "paged-fused" if self.fused else "paged-decode"
+        return "paged-gather"
 
     def dispatch_cost(self, rows, steps: int = 1) -> dict:
         """Cost of one landed dispatch.
@@ -432,6 +439,19 @@ def model_from_engine(engine) -> CostModel | None:
                         getattr(dev, "platform", None))
         except Exception:
             pass
+        fused = False
+        if engine.paged:
+            try:
+                # ask the attention ladder what the decode trace will
+                # actually pick for this geometry (probe is cached), so
+                # cost families track the ledger path
+                from ..ops import attention as _attn
+                fused, _ = _attn._fused_choice(
+                    1, cfg.n_heads, cfg.n_kv_heads,
+                    int(getattr(engine, "kv_page_size", 0) or 0),
+                    cfg.dim // cfg.n_heads, kv_codec == "kv_int8")
+            except Exception:
+                fused = False
         return CostModel(
             dim=cfg.dim, hidden_dim=cfg.hidden_dim, n_layers=cfg.n_layers,
             n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads,
@@ -440,7 +460,8 @@ def model_from_engine(engine) -> CostModel | None:
             tp=engine.mesh.shape.get("tp", 1), paged=bool(engine.paged),
             page_size=getattr(engine, "kv_page_size", 0) or 0,
             n_experts=getattr(cfg, "n_experts", 0) or 0,
-            n_active_experts=getattr(cfg, "n_active_experts", 0) or 0)
+            n_active_experts=getattr(cfg, "n_active_experts", 0) or 0,
+            fused=fused)
     except Exception:
         return None
 

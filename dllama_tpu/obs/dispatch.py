@@ -61,8 +61,12 @@ def record_degrade(codec: str, reason: str, *, warn_key=None, **ctx) -> None:
     so a degrade firing on every layer of every forward logs once, while
     the counter keeps the true occurrence count."""
     global _degraded
-    counter = obs_metrics.Q8_DEGRADE if codec == "q8" \
-        else obs_metrics.Q40_DEGRADE
+    if codec == "q8":
+        counter = obs_metrics.Q8_DEGRADE
+    elif codec == "attn":
+        counter = obs_metrics.ATTN_DEGRADE
+    else:
+        counter = obs_metrics.Q40_DEGRADE
     counter.inc(reason)
     with _lock:
         _degraded = True
@@ -161,6 +165,7 @@ def reset() -> None:
     obs_metrics.MATMUL_DISPATCH.reset()
     obs_metrics.Q40_DEGRADE.reset()
     obs_metrics.Q8_DEGRADE.reset()
+    obs_metrics.ATTN_DEGRADE.reset()
     obs_metrics.DISPATCH_FLOPS.reset()
     obs_metrics.DISPATCH_BYTES.reset()
     obs_metrics.CLASS_CHIP_MS.reset()

@@ -535,6 +535,10 @@ Q40_DEGRADE = REGISTRY.labeled_counter(
 Q8_DEGRADE = REGISTRY.labeled_counter(
     "q8_degrade", "reason",
     "Q80 dispatches degraded off the fused Pallas path, by reason.")
+ATTN_DEGRADE = REGISTRY.labeled_counter(
+    "attn_degrade", "reason",
+    "Paged-attention dispatches degraded off the fused page-walk Pallas "
+    "kernel (ops/attention.py paged-fused), by reason.")
 
 # performance economics (obs/cost.py): the analytic roofline model's
 # FLOPs / bytes-moved per dispatch family, per-class chip-time
@@ -545,7 +549,8 @@ DISPATCH_FLOPS = REGISTRY.labeled_counter(
     "dispatch_flops", ("codec", "path", "phase"),
     "Model FLOPs per analytic dispatch family: weight codec or KV codec, "
     "cost path (matmul / attention / paged-gather / paged-decode / "
-    "tp-ring), and request phase (prefill / decode / verify).")
+    "paged-fused / tp-ring), and request phase (prefill / decode / "
+    "verify).")
 DISPATCH_BYTES = REGISTRY.labeled_counter(
     "dispatch_bytes", ("codec", "path", "phase"),
     "Bytes moved per analytic dispatch family (same labels as "

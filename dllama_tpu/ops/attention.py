@@ -14,6 +14,10 @@ and is the axis sharded across the tensor-parallel mesh.
 
 from __future__ import annotations
 
+import functools
+import math
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -286,35 +290,289 @@ def paged_decode_attention(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
     return out.reshape(b, hq, t, dh).astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Fused paged-attention megakernel (one-dispatch decode, ROADMAP item 2).
+#
+# One Pallas program per decode step walks the page table directly via
+# scalar prefetch: grid (B, max_pages), each step's KV block is DMA'd
+# straight out of the pool at ``pool[layer, table[b, p]]`` — no
+# materialized (B, Hkv, maxp·ps, Dh) gather, no separate dequant pass for
+# int8 pools (the per-position scale plane rides as a second prefetched
+# block and the cast*scale happens in-register), and the online-softmax
+# accumulators live in VMEM scratch across the page walk.  Gating mirrors
+# the q40 matmul ladder: ``DLLAMA_FUSED_ATTN`` auto/on/off/interp, a
+# cached hardware probe guards auto, and every forced-path fallback goes
+# through the warn-once degrade ledger (obs/dispatch.py).
+
+
+_FUSED_ENV = "DLLAMA_FUSED_ATTN"
+
+
+def fused_mode() -> str:
+    """The fused paged-attention gate, read lazily so tests and the
+    bench A/B can flip it per engine: ``auto`` (TPU + probe, silent CPU
+    fallback), ``on`` (degrade loudly if unusable), ``off``, ``interp``
+    (force the kernel in Pallas interpret mode — CPU parity tests and
+    the ``-fused4`` A/B)."""
+    return os.environ.get(_FUSED_ENV, "auto").strip().lower() or "auto"
+
+
+def _make_fused_kernel(hq: int, hkv: int, dh: int, ps: int, maxp: int,
+                       quantized: bool, out_dtype):
+    """Build the fused decode kernel body for one (head/page) geometry.
+
+    Ref order: 3 scalar-prefetch refs (layer (1,), page table (B, maxp),
+    per-row positions (B,)), then the q block and the page-walk KV blocks
+    (+ scale blocks when quantized), the output block, and the VMEM
+    scratch accumulators (running max, denom, numerator) that persist
+    across the page axis of the grid."""
+    g = hq // hkv
+    inv_sqrt = np.float32(1.0 / math.sqrt(dh))
+
+    def kernel(layer_ref, ptab_ref, pos_ref, q_ref, k_ref, v_ref, *rest):
+        del layer_ref, ptab_ref  # consumed by the BlockSpec index maps
+        if quantized:
+            ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+        else:
+            o_ref, m_ref, l_ref, acc_ref = rest
+        from jax.experimental import pallas as plx
+        b = plx.program_id(0)
+        p = plx.program_id(1)
+        pos = pos_ref[b]
+
+        @plx.when(p == 0)
+        def _init():
+            m_ref[...] = jnp.full(m_ref.shape, _NEG, jnp.float32)
+            l_ref[...] = jnp.zeros(l_ref.shape, jnp.float32)
+            acc_ref[...] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+        # pages past the row's live prefix are skipped entirely (their
+        # BlockSpec index map also clamps to the last live page, so the
+        # prefetch pipeline issues no new DMA for them)
+        @plx.when(p <= pos // ps)
+        def _fold():
+            k = k_ref[0, 0]  # (Hkv, ps, Dh)
+            v = v_ref[0, 0]
+            if quantized:
+                # in-register dequant: int8 page block × per-position
+                # scale column → bf16 dot operands (dequant_kv semantics,
+                # without the materialized intermediate)
+                k = (k.astype(jnp.float32) * ks_ref[0, 0]).astype(jnp.bfloat16)
+                v = (v.astype(jnp.float32) * vs_ref[0, 0]).astype(jnp.bfloat16)
+            qb = q_ref[0].reshape(hkv, g, dh).astype(k.dtype)
+            # (Hkv, G, ps): score dot batched over the kv-head axis, f32
+            # accumulation like _online_fold
+            scores = jax.lax.dot_general(
+                qb, k, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32) * inv_sqrt
+            s_idx = p * ps + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 2)
+            scores = jnp.where(s_idx <= pos, scores, _NEG)
+            sc = scores.reshape(hq, ps)
+            m_prev = m_ref[:, 0:1]                      # (Hq, 1)
+            l_prev = l_ref[:, 0:1]
+            m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            pexp = jnp.exp(sc - m_new)                  # (Hq, ps)
+            l_new = alpha * l_prev + jnp.sum(pexp, axis=1, keepdims=True)
+            pv = jax.lax.dot_general(
+                pexp.reshape(hkv, g, ps).astype(v.dtype), v,
+                (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)     # (Hkv, G, Dh)
+            acc_ref[...] = alpha * acc_ref[...] + pv.reshape(hq, dh)
+            m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+            l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+        @plx.when(p == maxp - 1)
+        def _emit():
+            l = jnp.maximum(l_ref[:, 0:1], 1e-38)
+            o_ref[0] = (acc_ref[...] / l).astype(out_dtype)
+
+    return kernel
+
+
+def fused_paged_attention(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
+                          layer: jax.Array, page_table: jax.Array,
+                          pos_rows: jax.Array,
+                          scales: tuple[jax.Array, jax.Array] | None = None,
+                          *, interpret: bool = False) -> jax.Array:
+    """Single-token paged GQA as ONE kernel: page-table walk, (optional)
+    in-register int8 dequant, and online-softmax fold in a single
+    pallas_call.  Numerics mirror :func:`paged_decode_attention`'s fold
+    (same operand dtypes, f32 accumulation, ``_NEG`` mask fill); rows
+    whose table runs out read their last live page again, fully masked.
+    """
+    from jax.experimental import pallas as plx
+    from jax.experimental.pallas import tpu as pltpu
+
+    from . import pallas_compat
+
+    b, hq, t, dh = q.shape
+    if t != 1:
+        raise ValueError("fused paged attention is decode-only (T must be 1)")
+    hkv, ps = pool_k.shape[2], pool_k.shape[3]
+    maxp = page_table.shape[1]
+    quantized = scales is not None
+
+    def walk_map(bi, pi, layer_r, ptab_r, pos_r):
+        # dead pages revisit the row's last live page: consecutive equal
+        # block indices skip the DMA, so traffic stays O(live pages)
+        pp = jnp.minimum(pi, pos_r[bi] // ps)
+        return (layer_r[0], ptab_r[bi, pp], 0, 0, 0)
+
+    def row_map(bi, pi, *_):
+        return (bi, 0, 0)
+
+    kv_spec = plx.BlockSpec((1, 1, hkv, ps, dh), walk_map)
+    in_specs = [plx.BlockSpec((1, hq, dh), row_map), kv_spec, kv_spec]
+    operands = [q[:, :, 0, :], pool_k, pool_v]
+    if quantized:
+        sc_spec = plx.BlockSpec((1, 1, hkv, ps, 1), walk_map)
+        in_specs += [sc_spec, sc_spec]
+        operands += [scales[0], scales[1]]
+    kernel = _make_fused_kernel(hq, hkv, dh, ps, maxp, quantized, q.dtype)
+    out = plx.pallas_call(
+        kernel,
+        grid_spec=pallas_compat.prefetch_grid_spec(
+            num_scalar_prefetch=3,
+            grid=(b, maxp),
+            in_specs=in_specs,
+            out_specs=plx.BlockSpec((1, hq, dh), row_map),
+            scratch_shapes=[pltpu.VMEM((hq, 128), jnp.float32),
+                            pltpu.VMEM((hq, 128), jnp.float32),
+                            pltpu.VMEM((hq, dh), jnp.float32)]),
+        out_shape=jax.ShapeDtypeStruct((b, hq, dh), q.dtype),
+        compiler_params=pallas_compat.compiler_params(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(jnp.atleast_1d(layer).astype(jnp.int32),
+      page_table.astype(jnp.int32), pos_rows.astype(jnp.int32), *operands)
+    return out[:, :, None, :]
+
+
+@functools.cache
+def _fused_ok(hkv: int, g: int, ps: int, dh: int, quantized: bool) -> bool:
+    """Hardware probe: can Mosaic lower + run the fused paged kernel at
+    this (head, page) geometry?  Guards the ``auto``/``on`` ladder so a
+    lowering failure (tiny page lane widths, odd head dims) degrades to
+    the gather+score path with a warn-once ledger entry instead of
+    crashing decode.  The fixture is RANDOM (fixed seed) with ragged row
+    positions, so a walk-order or mask bug fails the value check rather
+    than shipping wrong numerics (same contract as q40._pallas_ok)."""
+    try:
+        b, maxp = 2, 3
+        npages = 1 + b * maxp
+        rng = np.random.RandomState(0)
+        table = np.arange(1, npages).reshape(b, maxp).astype(np.int32)
+        pos_rows = jnp.asarray([maxp * ps - 1, ps + ps // 2], jnp.int32)
+        q = jnp.asarray(rng.randn(b, hkv * g, 1, dh) * 0.3, jnp.float32)
+        if quantized:
+            # quantize_kv reduces over the last axis, so it quantizes the
+            # pool layout (1, P, Hkv, ps, Dh) directly → scale (…, ps, 1)
+            pk, sk = quantize_kv(jnp.asarray(
+                rng.randn(1, npages, hkv, ps, dh), jnp.float32))
+            pv, sv = quantize_kv(jnp.asarray(
+                rng.randn(1, npages, hkv, ps, dh), jnp.float32))
+            ref_scales = (sk, sv)
+        else:
+            pk = jnp.asarray(rng.randn(1, npages, hkv, ps, dh) * 0.3,
+                             jnp.bfloat16)
+            pv = jnp.asarray(rng.randn(1, npages, hkv, ps, dh) * 0.3,
+                             jnp.bfloat16)
+            ref_scales = None
+        layer = jnp.int32(0)
+        tbl = jnp.asarray(table)
+        out = fused_paged_attention(
+            q, pk, pv, layer, tbl, pos_rows,
+            scales=(sk, sv) if quantized else None)
+        ksc, vsc = (ref_scales if quantized else (None, None))
+        k_l = paged_gather_layer(pk, layer, tbl, scale_pool=ksc)
+        v_l = paged_gather_layer(pv, layer, tbl, scale_pool=vsc)
+        ref = _rows_ceiling_attention(q, k_l, v_l, pos_rows)
+        tol = 1e-2 * max(float(np.abs(np.asarray(ref)).max()), 1e-3)
+        if not np.allclose(np.asarray(out), np.asarray(ref), atol=tol):
+            raise AssertionError("fused attention probe result mismatch")
+        return True
+    except Exception as e:  # Mosaic lowering/runtime failure
+        from ..obs import dispatch as obs_dispatch
+        obs_dispatch.record_degrade(
+            "attn", "probe_failed", warn_key=(hkv, g, ps, dh, quantized),
+            hkv=hkv, g=g, page_size=ps, dh=dh, quantized=quantized,
+            error=f"{type(e).__name__}: {str(e)[:120]}")
+        return False
+
+
+def _fused_choice(t: int, hq: int, hkv: int, ps: int, dh: int,
+                  quantized: bool) -> tuple[bool, bool]:
+    """Resolve the fused-vs-fallback decision for one trace-time call
+    site.  Returns ``(use_fused, interpret)``.  Mirrors the q40 ladder:
+    ``auto`` off-TPU falls back silently (the clean-run ledger contract);
+    ``on`` off-TPU and any probe failure degrade loudly (warn-once)."""
+    mode = fused_mode()
+    if mode == "off" or t != 1 or hq % hkv != 0:
+        return False, False
+    if mode == "interp":
+        return True, True
+    on_tpu = jax.default_backend() == "tpu"
+    if mode == "on" and not on_tpu:
+        from ..obs import dispatch as obs_dispatch
+        obs_dispatch.record_degrade(
+            "attn", "fused_needs_tpu", warn_key=jax.default_backend(),
+            backend=jax.default_backend())
+        return False, False
+    if not on_tpu:  # auto on CPU: silent XLA fallback, same as q40
+        return False, False
+    return _fused_ok(hkv, hq // hkv, ps, dh, quantized), False
+
+
 def paged_gqa_attention_at(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
                            layer: jax.Array, page_table: jax.Array,
                            pos_rows: jax.Array,
                            scales: tuple[jax.Array, jax.Array] | None = None
                            ) -> jax.Array:
     """Causal GQA read through the page-table indirection at ``layer``,
-    with the slot path's per-row causal ceiling.  Dispatch mirrors the
-    contiguous path: long-cache single-token decode walks live pages
-    (:func:`paged_decode_attention`, O(max pos) traffic); everything else
-    gathers the logical view and reuses the one-shot slot math, so paged
-    and contiguous reads are the same computation over the same logical
-    keys.
+    with the slot path's per-row causal ceiling.  Single-token decode
+    prefers the fused page-walk megakernel (:func:`fused_paged_attention`
+    — one dispatch, no materialized gather, in-register int8 dequant)
+    when the ``DLLAMA_FUSED_ATTN`` ladder resolves to it; otherwise
+    dispatch mirrors the contiguous path: long-cache single-token decode
+    walks live pages (:func:`paged_decode_attention`, O(max pos)
+    traffic); everything else gathers the logical view and reuses the
+    one-shot slot math, so paged and contiguous reads are the same
+    computation over the same logical keys.
+
+    Every arm records its dispatch family at trace time (the PR 4
+    ledger): ``paged-fused`` is one attention-family dispatch; the
+    unfused one-shot arm is the materialized gather (``paged-gather``)
+    plus the score/softmax pass (``attn-score``), plus a ``dequant``
+    record for int8 pools whose scale multiply rides the gathered view.
 
     ``scales``: the int8-pool (k, v) scale planes (L, P, Hkv, ps, 1);
-    both dispatch arms dequantize after the int8-sized page read."""
+    every unfused arm dequantizes after the int8-sized page read."""
+    from ..obs import dispatch as obs_dispatch
     t = q.shape[2]
     ps = pool_k.shape[3]
     s = page_table.shape[1] * ps
-    if scales is not None:
-        # trace-time ledger entry like the q40/q8 matmul paths: an int8
-        # paged read is a codec decision a bench number must not hide
-        from ..obs import dispatch as obs_dispatch
-        obs_dispatch.record_dispatch(
-            "kv_int8",
-            "paged-decode" if _use_blocked_decode(t, s) else "paged-gather",
-            t=t, s=s, page_size=ps)
+    codec = "kv_int8" if scales is not None else "kv_dense"
+    use_fused, interp = _fused_choice(t, q.shape[1], pool_k.shape[2], ps,
+                                      pool_k.shape[4], scales is not None)
+    if use_fused:
+        obs_dispatch.record_dispatch(codec, "paged-fused", t=t, s=s,
+                                     page_size=ps, interpret=interp)
+        return fused_paged_attention(q, pool_k, pool_v, layer, page_table,
+                                     pos_rows, scales=scales,
+                                     interpret=interp)
     if _use_blocked_decode(t, s):
+        obs_dispatch.record_dispatch(codec, "paged-decode", t=t, s=s,
+                                     page_size=ps)
         return paged_decode_attention(q, pool_k, pool_v, layer, page_table,
                                       pos_rows, scales=scales)
+    obs_dispatch.record_dispatch(codec, "paged-gather", t=t, s=s,
+                                 page_size=ps)
+    obs_dispatch.record_dispatch(codec, "attn-score", t=t, s=s, page_size=ps)
+    if scales is not None:
+        obs_dispatch.record_dispatch("kv_int8", "dequant", t=t, s=s,
+                                     page_size=ps)
     ks, vs = scales if scales is not None else (None, None)
     k_l = paged_gather_layer(pool_k, layer, page_table, scale_pool=ks)
     v_l = paged_gather_layer(pool_v, layer, page_table, scale_pool=vs)

@@ -26,3 +26,19 @@ def compiler_params(**kw):
     """Build the installed jax's Pallas TPU compiler-params object,
     keeping only the fields this jax version knows about."""
     return _CLS(**{k: v for k, v in kw.items() if k in _FIELDS})
+
+
+def prefetch_grid_spec(**kw):
+    """Scalar-prefetch grid spec across jax versions.
+
+    ``pltpu.PrefetchScalarGridSpec`` is the spelling every jax in our
+    support window exports, but newer releases fold the same fields into
+    the generic ``pl.GridSpec(num_scalar_prefetch=...)``; resolve
+    whichever the installed jax carries (the page-table-walking fused
+    attention kernel indexes its KV blocks through the prefetched
+    table, so this spec is load-bearing, not an optimization hint)."""
+    cls = getattr(pltpu, "PrefetchScalarGridSpec", None)
+    if cls is not None:
+        return cls(**kw)
+    from jax.experimental import pallas as _pl
+    return _pl.GridSpec(**kw)

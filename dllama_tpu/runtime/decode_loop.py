@@ -9,9 +9,13 @@ a ``lax.scan``: one dispatch yields a chunk of K tokens and only the int32
 token ids cross the boundary.
 
 Sampling parity: greedy (temperature 0) is exact argmax, identical to the
-reference.  Temperature/top-p uses the JAX counter-based PRNG instead of
-the reference's xorshift stream — same distribution, different stream; the
-host Sampler (sampling.py) remains available for bit-exact parity runs.
+reference.  Temperature/top-k/top-p runs ``sampling.sample_on_device`` —
+a branch-for-branch mirror of the host reference's decision rules driven
+by one uniform coin per (row, step), so a fixed coin picks the same token
+as ``sampling.sample_with_coin`` on the host.  The *coin stream* comes
+from the engine's device-resident JAX key (threefry), not the reference's
+xorshift; the host Sampler (sampling.py) remains available for bit-exact
+parity runs against the reference stream.
 """
 
 from __future__ import annotations
@@ -22,33 +26,42 @@ import jax.numpy as jnp
 from ..models.config import ModelConfig
 from ..models.transformer import (KVCache, forward_last, forward_slots,
                                   forward_slots_all)
-from ..ops.kernels import softmax_f32
+from ..sampling import sample_on_device
+
+
+def _record_sample_dev(rows: int) -> None:
+    # trace-time ledger entry (once per compiled call site, like the
+    # matmul/attention paths): the sampled stage ran on device, no host
+    # round trip
+    from ..obs import dispatch as obs_dispatch
+    obs_dispatch.record_dispatch("sample", "sample-dev", rows=rows)
 
 
 def device_sample(logits: jax.Array, key: jax.Array, temperature: float,
-                  topp: float) -> jax.Array:
+                  topp: float, topk: int = 0,
+                  mask: jax.Array | None = None) -> jax.Array:
     """Sample token ids (B,) from logits (B, V) on device.
 
-    Mirrors Sampler::sample's three modes (tokenizer.cpp:384-407):
-    temperature 0 → argmax; top-p outside (0,1) → plain multinomial;
-    otherwise nucleus sampling.  ``temperature``/``topp`` are static so each
-    mode compiles to its own minimal program.
+    Mirrors Sampler::sample's modes (tokenizer.cpp:384-407): temperature
+    0 → argmax; top-p outside (0,1) → plain multinomial; otherwise
+    nucleus sampling — all via :func:`sampling.sample_on_device`, the
+    coin-based host mirror.  ``temperature``/``topp``/``topk`` are
+    static so each mode compiles to its own minimal program; ``mask`` is
+    the optional vocab keep-mask (grammar seam, identity today).
     """
     if temperature == 0.0:
+        if mask is not None:
+            logits = jnp.where(jnp.asarray(mask).astype(bool), logits,
+                               -jnp.inf)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
-    probs = softmax_f32(logits / temperature)  # (B, V)
-    if topp <= 0.0 or topp >= 1.0:
-        return jax.random.categorical(key, jnp.log(probs), axis=-1).astype(jnp.int32)
-
-    # nucleus: sort descending, keep the smallest prefix with mass > topp
-    # (tokenizer.cpp:328-369 semantics), renormalize, sample within it
-    sorted_probs, sorted_idx = jax.lax.top_k(probs, probs.shape[-1])
-    cum = jnp.cumsum(sorted_probs, axis=-1)
-    keep = (cum - sorted_probs) < topp  # include the first token crossing topp
-    filtered = jnp.where(keep, sorted_probs, 0.0)
-    choice = jax.random.categorical(key, jnp.log(filtered), axis=-1)  # index into sorted order
-    return jnp.take_along_axis(sorted_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+    b = logits.shape[0]
+    _record_sample_dev(b)
+    coins = jax.random.uniform(key, (b,), jnp.float32)
+    return sample_on_device(
+        logits, coins,
+        jnp.full((b,), temperature, jnp.float32),
+        jnp.full((b,), topp, jnp.float32),
+        jnp.full((b,), topk, jnp.int32), mask=mask)
 
 
 def decode_chunk(params, cfg: ModelConfig, cache: KVCache, token: jax.Array,
@@ -79,39 +92,40 @@ def decode_chunk(params, cfg: ModelConfig, cache: KVCache, token: jax.Array,
 
 
 def device_sample_rows(logits: jax.Array, key: jax.Array, temps: jax.Array,
-                       topps: jax.Array, greedy: bool) -> jax.Array:
+                       topps: jax.Array, greedy: bool,
+                       topks: jax.Array | None = None,
+                       mask: jax.Array | None = None) -> jax.Array:
     """Per-row-parameter sampling (B, V) → (B,) for continuous-batching
-    slots: rows belong to *different requests*, so temperature/top-p
-    arrive as (B,) traced arrays rather than static floats — one compiled
-    program serves any mix of per-request settings.  Rows with
+    slots: rows belong to *different requests*, so temperature/top-p/
+    top-k arrive as (B,) traced arrays rather than static floats — one
+    compiled program serves any mix of per-request settings.  Rows with
     temperature 0 take the exact argmax (same op as device_sample's
     greedy mode, so a slot stream is byte-identical to a solo greedy
     run); ``greedy`` is static and compiles an all-greedy batch down to
-    the argmax alone.
+    the argmax alone (no coin drawn, no key consumed).  Sampled rows run
+    :func:`sampling.sample_on_device` — the coin-based mirror of the
+    host reference, one uniform coin per row from ``key``.  ``mask`` is
+    the optional vocab keep-mask (grammar seam, identity today).
     """
-    arg = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if greedy:
-        return arg
-    t = jnp.maximum(temps, 1e-6)[:, None]
-    probs = softmax_f32(logits / t)  # (B, V)
-    # vectorized nucleus (device_sample semantics per row); top-p outside
-    # (0, 1) degrades to plain multinomial by widening the kept prefix to
-    # the whole vocab
-    sorted_probs, sorted_idx = jax.lax.top_k(probs, probs.shape[-1])
-    cum = jnp.cumsum(sorted_probs, axis=-1)
-    tp = jnp.where((topps > 0.0) & (topps < 1.0), topps, 1.0)[:, None]
-    keep = (cum - sorted_probs) < tp
-    filtered = jnp.where(keep, sorted_probs, 0.0)
-    choice = jax.random.categorical(key, jnp.log(filtered), axis=-1)
-    sampled = jnp.take_along_axis(sorted_idx, choice[:, None],
-                                  axis=-1)[:, 0].astype(jnp.int32)
-    return jnp.where(temps == 0.0, arg, sampled)
+        if mask is not None:
+            logits = jnp.where(jnp.asarray(mask).astype(bool), logits,
+                               -jnp.inf)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    b = logits.shape[0]
+    _record_sample_dev(b)
+    coins = jax.random.uniform(key, (b,), jnp.float32)
+    if topks is None:
+        topks = jnp.zeros((b,), jnp.int32)
+    return sample_on_device(logits, coins, temps, topps, topks, mask=mask)
 
 
 def slot_chunk(params, cfg: ModelConfig, cache: KVCache, tokens: jax.Array,
                pos_rows: jax.Array, n_valid: jax.Array, key: jax.Array,
-               temps: jax.Array, topps: jax.Array, *, steps: int,
-               greedy: bool, page_table: jax.Array | None = None):
+               temps: jax.Array, topps: jax.Array,
+               topks: jax.Array | None = None, *, steps: int,
+               greedy: bool, page_table: jax.Array | None = None,
+               vocab_mask: jax.Array | None = None):
     """One continuous-batching dispatch: a mixed prefill/decode forward
     over (B, T) slot rows, then ``steps - 1`` pure decode steps — all one
     XLA program, so slot serving keeps decode_chunk's amortization (only
@@ -124,12 +138,16 @@ def slot_chunk(params, cfg: ModelConfig, cache: KVCache, tokens: jax.Array,
     only when no slot is mid-prefill; free rows ride along at position 0
     and their samples are discarded host-side.
 
-    Returns (tokens (steps, B), cache, last (B,)).  ``last`` is the
+    Returns (tokens (steps, B), cache, last (B,), key).  ``last`` is the
     final sampled row — the same values as ``tokens[-1]``, surfaced as
     its own output so a pipelined caller can feed it straight into the
     next dispatch as a device array (no device→host→device round trip
-    in pure decode).  The caller advances per-slot positions host-side
-    (``pos += n_valid``, then +1 per extra step).
+    in pure decode).  ``key`` is the advanced device RNG key: sampled
+    chunks split one sub-key per step and return the chain tail, so the
+    engine can thread it into the next dispatch without a host round
+    trip (greedy chunks return it untouched — no coin was drawn).  The
+    caller advances per-slot positions host-side (``pos += n_valid``,
+    then +1 per extra step).
 
     ``page_table`` (B, max_pages) switches the cache to a paged pool:
     pages are pre-reserved at admission for the whole request (prompt +
@@ -138,8 +156,12 @@ def slot_chunk(params, cfg: ModelConfig, cache: KVCache, tokens: jax.Array,
     """
     logits, cache = forward_slots(params, cfg, tokens, cache, pos_rows,
                                   n_valid, page_table=page_table)
-    key, sub = jax.random.split(key)
-    first = device_sample_rows(logits, sub, temps, topps, greedy)
+    if not greedy:
+        key, sub = jax.random.split(key)
+    else:
+        sub = key
+    first = device_sample_rows(logits, sub, temps, topps, greedy, topks,
+                               vocab_mask)
     pos_rows = pos_rows + n_valid
 
     def body(carry, _):
@@ -147,31 +169,38 @@ def slot_chunk(params, cfg: ModelConfig, cache: KVCache, tokens: jax.Array,
         logits, cache = forward_slots(params, cfg, tok[:, None], cache,
                                       pos_rows, jnp.ones_like(pos_rows),
                                       page_table=page_table)
-        key, sub = jax.random.split(key)
-        nxt = device_sample_rows(logits, sub, temps, topps, greedy)
+        if not greedy:
+            key, sub = jax.random.split(key)
+        else:
+            sub = key
+        nxt = device_sample_rows(logits, sub, temps, topps, greedy, topks,
+                                 vocab_mask)
         return (cache, nxt, pos_rows + 1, key), nxt
 
     if steps > 1:
-        (cache, last, _, _), rest = jax.lax.scan(
+        (cache, last, _, key), rest = jax.lax.scan(
             body, (cache, first, pos_rows, key), None, length=steps - 1)
         toks = jnp.concatenate([first[None], rest], axis=0)
     else:
         toks, last = first[None], first
-    return toks, cache, last
+    return toks, cache, last, key
 
 
 def slot_verify_chunk(params, cfg: ModelConfig, cache: KVCache,
                       tokens: jax.Array, pos_rows: jax.Array,
                       n_valid: jax.Array, key: jax.Array, temps: jax.Array,
-                      topps: jax.Array, *, greedy: bool,
-                      page_table: jax.Array | None = None):
+                      topps: jax.Array, topks: jax.Array | None = None,
+                      *, greedy: bool, page_table: jax.Array | None = None,
+                      vocab_mask: jax.Array | None = None):
     """One ragged slot-verify dispatch (speculative decoding's verify
     side, Leviathan et al. 2023 greedy rule): row ``r`` feeds
     ``[last_token, d_1..d_{n_valid[r]-1}]`` — its previous sample plus
     its proposed draft tokens — and gets back the model's prediction at
     every fed position plus the count of leading drafts that matched.
 
-    Returns ``(preds (B, T), cache, accepted (B,), last (B,))``:
+    Returns ``(preds (B, T), cache, accepted (B,), last (B,), key)``
+    (``key`` advanced one split for sampled batches, untouched for
+    greedy — same chain contract as :func:`slot_chunk`):
 
     * ``preds[r, j]`` is the true next token after ``tokens[r, :j+1]``
       (argmax for greedy rows, so every emitted token is byte-identical
@@ -197,7 +226,8 @@ def slot_verify_chunk(params, cfg: ModelConfig, cache: KVCache,
     preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, T)
     if not greedy:
         key, sub = jax.random.split(key)
-        first = device_sample_rows(logits[:, 0], sub, temps, topps, greedy)
+        first = device_sample_rows(logits[:, 0], sub, temps, topps, greedy,
+                                   topks, vocab_mask)
         preds = preds.at[:, 0].set(first)
     t = tokens.shape[1]
     # leading-match count: draft j (fed at column j+1) is accepted iff it
@@ -208,4 +238,4 @@ def slot_verify_chunk(params, cfg: ModelConfig, cache: KVCache,
     accepted = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
     accepted = accepted.astype(jnp.int32)  # (B,)
     last = jnp.take_along_axis(preds, accepted[:, None], axis=1)[:, 0]
-    return preds, cache, accepted, last
+    return preds, cache, accepted, last, key

@@ -398,6 +398,16 @@ class Engine:
         self._compiled_steps: set = set()
         self._key = jax.random.PRNGKey(0)
         self._chunk_counter = 0
+        # device-resident RNG chain for sampled slot dispatches: seeded
+        # lazily off the host stream, then advanced by the key each
+        # compiled chunk returns — sampled pure decode never syncs the
+        # host for randomness (one-dispatch decode, ISSUE 20)
+        self._dev_key: jax.Array | None = None
+        # which sampling implementation owns this engine's draws; rides
+        # snapshots/hand-off records so a sampled slot never resumes on
+        # a replica whose stream would diverge
+        self.sampling_path = os.environ.get(
+            "DLLAMA_SAMPLING_PATH", "device").strip().lower() or "device"
         # collective-latency probe (probe_collective): compiled lazily on
         # first use, rate-limited host-side
         self._collective_fn = None
@@ -458,7 +468,10 @@ class Engine:
         from . import snapshot as snapfmt
         arrays = {n: np.asarray(a) for n, a in self._cache_arrays().items()}
         arrays["rng_key"] = np.asarray(self._key)
+        if self._dev_key is not None:
+            arrays["rng_dev_key"] = np.asarray(self._dev_key)
         meta_extra = dict(extra or {})
+        meta_extra.setdefault("sampling_path", self.sampling_path)
         if self._offsets is not None:
             arrays["offsets"] = np.asarray(self._offsets)
             meta_extra["has_offsets"] = True
@@ -509,6 +522,15 @@ class Engine:
             raise snapfmt.SnapshotMismatch(
                 path, "pos", "restored position outside the context window",
                 expected=f"0..{self.seq_len}", got=pos)
+        snap_sp = meta.get("extra", {}).get("sampling_path")
+        if snap_sp is not None and snap_sp != self.sampling_path:
+            # a sampled stream drawn on one path cannot continue on the
+            # other without silently changing the distribution — refuse
+            # (absent flag = pre-ISSUE-20 snapshot, greedy-safe either way)
+            raise snapfmt.SnapshotMismatch(
+                path, "sampling_path",
+                "snapshot sampled on a different sampling path",
+                expected=self.sampling_path, got=snap_sp)
         if self.cache.quantized:
             cache = KVCache(cache_np["cache.k"], cache_np["cache.v"],
                             cache_np["cache.k_scale"], cache_np["cache.v_scale"])
@@ -519,11 +541,14 @@ class Engine:
         self._chunk_counter = int(meta["chunk_counter"])
         self._key = jnp.asarray(arrays["rng_key"]) if "rng_key" in arrays \
             else jax.random.PRNGKey(0)
+        self._dev_key = jnp.asarray(arrays["rng_dev_key"]) \
+            if "rng_dev_key" in arrays else None
         self._offsets = jnp.asarray(arrays["offsets"]) \
             if meta.get("extra", {}).get("has_offsets") else None
         # caller arrays saved via snapshot(extra_arrays=...) — e.g. the
         # paged scheduler's page tables — handed back out-of-band
-        known = set(self._cache_arrays()) | {"rng_key", "offsets"}
+        known = set(self._cache_arrays()) | {"rng_key", "rng_dev_key",
+                                             "offsets"}
         self.restored_arrays = {n: a for n, a in arrays.items()
                                 if n not in known}
         bump_counter("snapshot_restores")
@@ -561,11 +586,25 @@ class Engine:
         }
         return snapfmt.fingerprint(fields)
 
-    def set_rng(self, key_np, chunk_counter: int) -> None:
+    def set_rng(self, key_np, chunk_counter: int, dev_key_np=None) -> None:
         """Rebase the sampler RNG stream (hand-off import: continue the
-        exporting replica's draw sequence instead of this process's)."""
+        exporting replica's draw sequence instead of this process's).
+        ``dev_key_np`` rebases the device-resident sampling chain too, so
+        a preempted sampled slot resumes with an identical distribution;
+        None resets the chain to re-seed off the host stream."""
         self._key = jnp.asarray(key_np)
         self._chunk_counter = int(chunk_counter)
+        self._dev_key = None if dev_key_np is None else jnp.asarray(dev_key_np)
+
+    def _next_dev_key(self) -> jax.Array:
+        """Current device RNG chain head, seeding it from the host stream
+        on first use (fold_in keeps legacy greedy snapshots byte-stable:
+        the host stream itself never advances differently)."""
+        if self._dev_key is None:
+            self._dev_key = jax.random.fold_in(self._key,
+                                               self._chunk_counter)
+            self._chunk_counter += 1
+        return self._dev_key
 
     def probe_collective(self, min_interval_s: float = 0.5) -> float | None:
         """Time one tp all-reduce of a decode-width (1, dim) partial sum
@@ -1183,8 +1222,10 @@ class Engine:
     def slot_step_async(self, tokens_np: np.ndarray | None,
                         pos_rows_np: np.ndarray, n_valid_np: np.ndarray, *,
                         temps_np: np.ndarray, topps_np: np.ndarray,
+                        topks_np: np.ndarray | None = None,
                         steps: int = 1,
                         page_tables_np: np.ndarray | None = None,
+                        vocab_mask_np: np.ndarray | None = None,
                         feed_dev=None) -> "SlotDispatch":
         """Enqueue one continuous-batching dispatch over the
         slot-addressable batch WITHOUT blocking on the result: row ``r``
@@ -1216,9 +1257,14 @@ class Engine:
         one engine as long as their uses don't overlap in time (the
         scheduler's ``exclusive()`` guarantees that), and the scheduler
         tracks every slot's clock host-side.  Compiled per
-        ``(T, steps, all-greedy)``; temperature/top-p ride in as (B,)
-        arrays so heterogeneous requests share one program — a
-        feed-fed dispatch shares the T=1 executable with a host-fed one.
+        ``(T, steps, all-greedy, fused-attention mode, mask presence)``;
+        temperature/top-p/top-k ride in as (B,) arrays so heterogeneous
+        requests share one program — a feed-fed dispatch shares the T=1
+        executable with a host-fed one.  Sampled dispatches draw from the
+        device-resident key chain (:meth:`_next_dev_key`) and the chunk
+        returns the advanced key, so sampled ``feed_dev`` decode runs
+        with zero host round trips.  ``vocab_mask_np`` is the optional
+        (V,) or (B, V) boolean keep-mask (grammar seam, identity today).
 
         On a paged engine ``page_tables_np`` (B, max_pages) int32 is
         required: reads/writes indirect through it into the pool
@@ -1258,60 +1304,77 @@ class Engine:
                 f"slot step would write position {hi - 1} past seq_len "
                 f"{self.seq_len}; retire rows at the context edge first")
         greedy = bool(np.all(temps_np == 0.0))
-        key = ("slot_paged" if self.paged else "slot", t, steps, greedy)
+        from ..ops.attention import fused_mode
+        has_mask = vocab_mask_np is not None
+        key = ("slot_paged" if self.paged else "slot", t, steps, greedy,
+               fused_mode() if self.paged else "", has_mask)
         fresh = key not in self._chunk_fns
         if fresh:
             cfg = self.cfg
             if self.paged:
                 self._chunk_fns[key] = jax.jit(
-                    lambda p, c, tok, pr, nv, k, tm, tp, ptab: slot_chunk(
-                        p, cfg, c, tok, pr, nv, k, tm, tp,
-                        steps=steps, greedy=greedy, page_table=ptab),
+                    lambda p, c, tok, pr, nv, k, tm, tp, tk, ptab, vm=None:
+                    slot_chunk(
+                        p, cfg, c, tok, pr, nv, k, tm, tp, tk,
+                        steps=steps, greedy=greedy, page_table=ptab,
+                        vocab_mask=vm),
                     donate_argnums=(1,),
-                    out_shardings=(self._rep, self._cache_sh, self._rep))
+                    out_shardings=(self._rep, self._cache_sh, self._rep,
+                                   self._rep))
             else:
                 self._chunk_fns[key] = jax.jit(
-                    lambda p, c, tok, pr, nv, k, tm, tp: slot_chunk(
-                        p, cfg, c, tok, pr, nv, k, tm, tp,
-                        steps=steps, greedy=greedy),
+                    lambda p, c, tok, pr, nv, k, tm, tp, tk, vm=None:
+                    slot_chunk(
+                        p, cfg, c, tok, pr, nv, k, tm, tp, tk,
+                        steps=steps, greedy=greedy, vocab_mask=vm),
                     donate_argnums=(1,),
-                    out_shardings=(self._rep, self._cache_sh, self._rep))
+                    out_shardings=(self._rep, self._cache_sh, self._rep,
+                                   self._rep))
         self._note_executable(fresh, key=key)
         fn = self._chunk_fns[key]
-        sub = jax.random.fold_in(self._key, self._chunk_counter)
-        self._chunk_counter += 1
+        sub = self._next_dev_key()
         t0 = time.perf_counter()
         if feed_dev is not None:
             tok_arr = jnp.asarray(feed_dev, jnp.int32)[:, None]  # on device
         else:
             tok_arr = jnp.asarray(tokens_np, jnp.int32)
+        if topks_np is None:
+            topks_np = np.zeros(len(pos_rows_np), np.int32)
         args = (self.params, self.cache, tok_arr,
                 jnp.asarray(pos_rows_np, jnp.int32),
                 jnp.asarray(n_valid_np, jnp.int32), sub,
                 jnp.asarray(temps_np, jnp.float32),
-                jnp.asarray(topps_np, jnp.float32))
+                jnp.asarray(topps_np, jnp.float32),
+                jnp.asarray(topks_np, jnp.int32))
         if self.paged:
             args = args + (jnp.asarray(page_tables_np, jnp.int32),)
+        if has_mask:
+            args = args + (jnp.asarray(vocab_mask_np, bool),)
         with active_mesh(self.mesh):
-            toks_dev, self.cache, last_dev = fn(*args)
+            toks_dev, self.cache, last_dev, self._dev_key = fn(*args)
         return SlotDispatch(self, toks_dev, last_dev, t=t, steps=steps,
                             fresh=fresh, enqueued_at=t0)
 
     def slot_step(self, tokens_np: np.ndarray, pos_rows_np: np.ndarray,
                   n_valid_np: np.ndarray, *, temps_np: np.ndarray,
-                  topps_np: np.ndarray, steps: int = 1,
-                  page_tables_np: np.ndarray | None = None) -> np.ndarray:
+                  topps_np: np.ndarray,
+                  topks_np: np.ndarray | None = None, steps: int = 1,
+                  page_tables_np: np.ndarray | None = None,
+                  vocab_mask_np: np.ndarray | None = None) -> np.ndarray:
         """Synchronous :meth:`slot_step_async`: enqueue and immediately
         wait.  Returns the sampled ids (steps, B)."""
         return self.slot_step_async(
             tokens_np, pos_rows_np, n_valid_np, temps_np=temps_np,
-            topps_np=topps_np, steps=steps,
-            page_tables_np=page_tables_np).wait()
+            topps_np=topps_np, topks_np=topks_np, steps=steps,
+            page_tables_np=page_tables_np,
+            vocab_mask_np=vocab_mask_np).wait()
 
     def slot_verify_async(self, tokens_np: np.ndarray,
                           pos_rows_np: np.ndarray, n_valid_np: np.ndarray, *,
                           temps_np: np.ndarray, topps_np: np.ndarray,
-                          page_tables_np: np.ndarray | None = None
+                          topks_np: np.ndarray | None = None,
+                          page_tables_np: np.ndarray | None = None,
+                          vocab_mask_np: np.ndarray | None = None
                           ) -> "SlotVerifyDispatch":
         """Enqueue one ragged slot-VERIFY dispatch (the batched,
         per-slot generalization of :meth:`_verify_fn`'s single-stream
@@ -1363,41 +1426,49 @@ class Engine:
                 f"slot verify would write position {hi - 1} past seq_len "
                 f"{self.seq_len}; retire rows at the context edge first")
         greedy = bool(np.all(temps_np == 0.0))
+        from ..ops.attention import fused_mode
+        has_mask = vocab_mask_np is not None
         key = ("slot_verify_paged" if self.paged else "slot_verify",
-               t, greedy)
+               t, greedy, fused_mode() if self.paged else "", has_mask)
         fresh = key not in self._chunk_fns
         if fresh:
             cfg = self.cfg
             if self.paged:
                 self._chunk_fns[key] = jax.jit(
-                    lambda p, c, tok, pr, nv, k, tm, tp, ptab:
-                    slot_verify_chunk(p, cfg, c, tok, pr, nv, k, tm, tp,
-                                      greedy=greedy, page_table=ptab),
+                    lambda p, c, tok, pr, nv, k, tm, tp, tk, ptab, vm=None:
+                    slot_verify_chunk(p, cfg, c, tok, pr, nv, k, tm, tp, tk,
+                                      greedy=greedy, page_table=ptab,
+                                      vocab_mask=vm),
                     donate_argnums=(1,),
                     out_shardings=(self._rep, self._cache_sh,
-                                   self._rep, self._rep))
+                                   self._rep, self._rep, self._rep))
             else:
                 self._chunk_fns[key] = jax.jit(
-                    lambda p, c, tok, pr, nv, k, tm, tp:
-                    slot_verify_chunk(p, cfg, c, tok, pr, nv, k, tm, tp,
-                                      greedy=greedy),
+                    lambda p, c, tok, pr, nv, k, tm, tp, tk, vm=None:
+                    slot_verify_chunk(p, cfg, c, tok, pr, nv, k, tm, tp, tk,
+                                      greedy=greedy, vocab_mask=vm),
                     donate_argnums=(1,),
                     out_shardings=(self._rep, self._cache_sh,
-                                   self._rep, self._rep))
+                                   self._rep, self._rep, self._rep))
         self._note_executable(fresh, key=key)
         fn = self._chunk_fns[key]
-        sub = jax.random.fold_in(self._key, self._chunk_counter)
-        self._chunk_counter += 1
+        sub = self._next_dev_key()
         t0 = time.perf_counter()
+        if topks_np is None:
+            topks_np = np.zeros(len(pos_rows_np), np.int32)
         args = (self.params, self.cache, jnp.asarray(tokens_np, jnp.int32),
                 jnp.asarray(pos_rows_np, jnp.int32),
                 jnp.asarray(n_valid_np, jnp.int32), sub,
                 jnp.asarray(temps_np, jnp.float32),
-                jnp.asarray(topps_np, jnp.float32))
+                jnp.asarray(topps_np, jnp.float32),
+                jnp.asarray(topks_np, jnp.int32))
         if self.paged:
             args = args + (jnp.asarray(page_tables_np, jnp.int32),)
+        if has_mask:
+            args = args + (jnp.asarray(vocab_mask_np, bool),)
         with active_mesh(self.mesh):
-            preds_dev, self.cache, accepted_dev, last_dev = fn(*args)
+            preds_dev, self.cache, accepted_dev, last_dev, self._dev_key = \
+                fn(*args)
         return SlotVerifyDispatch(self, preds_dev, accepted_dev, last_dev,
                                   t=t, fresh=fresh, enqueued_at=t0)
 

@@ -113,11 +113,12 @@ class Ticket:
     HTTP handler thread; ``cancel`` may be called from either side."""
 
     def __init__(self, prompt, max_new, temperature, top_p, eos_ids,
-                 deadline, priority: int = 1):
+                 deadline, priority: int = 1, top_k: int = 0):
         self.prompt = list(prompt)
         self.max_new = int(max_new)
         self.temperature = float(temperature)
         self.top_p = float(top_p)
+        self.top_k = int(top_k)
         self.eos_ids = tuple(eos_ids)
         self.deadline = deadline  # time.monotonic() or None
         # finish: stop/length/timeout/aborted/error/handoff/preempted
@@ -221,7 +222,7 @@ class _Pending:
     the dispatch it is currently landing (depth 2)."""
 
     __slots__ = ("handle", "error", "active", "tickets", "steps",
-                 "t_width", "n_valid", "temps", "topps", "prefset",
+                 "t_width", "n_valid", "temps", "topps", "topks", "prefset",
                  "rid_by_slot", "fed_by_slot", "pos_rows", "enq_tp",
                  "t0_mono", "host_gap_ms", "idle_ms", "overlapped",
                  "queued", "verify", "proposed_by_slot")
@@ -365,7 +366,7 @@ class SlotScheduler:
     # -- submission-side API -------------------------------------------
     def submit(self, prompt: list[int], max_new: int, *,
                temperature: float = 0.0, top_p: float = 0.9,
-               eos_ids: tuple[int, ...] = (),
+               top_k: int = 0, eos_ids: tuple[int, ...] = (),
                deadline: float | None = None,
                priority: int = 1) -> Ticket:
         """Queue one request; returns its :class:`Ticket` immediately.
@@ -390,7 +391,8 @@ class SlotScheduler:
                     f"{self.pool.capacity}; raise --kv-pages or shorten "
                     "the request")
         t = Ticket(prompt, max_new, temperature, top_p, eos_ids, deadline,
-                   priority=max(0, min(max(PRIORITY_NAMES), int(priority))))
+                   priority=max(0, min(max(PRIORITY_NAMES), int(priority))),
+                   top_k=top_k)
         with self._cond:
             if self._stop or self._draining:
                 raise SchedulerClosed("scheduler is draining")
@@ -619,11 +621,15 @@ class SlotScheduler:
                       for name, a in rec[0].items()}
             with self._engine_lock:
                 arrays["rng_key"] = np.asarray(self.engine._key)
+                if self.engine._dev_key is not None:
+                    arrays["rng_dev_key"] = np.asarray(self.engine._dev_key)
                 chunk_counter = self.engine._chunk_counter
         else:
             with self._engine_lock:
                 arrays = self.engine.read_pool_pages(s.pages[:n_data])
                 arrays["rng_key"] = np.asarray(self.engine._key)
+                if self.engine._dev_key is not None:
+                    arrays["rng_dev_key"] = np.asarray(self.engine._dev_key)
                 chunk_counter = self.engine._chunk_counter
         from . import snapshot as snapfmt
         return snapfmt.dumps_request(
@@ -633,6 +639,8 @@ class SlotScheduler:
                 "rid": t.rid, "prompt": list(t.prompt),
                 "completion": list(t.emitted), "max_new": t.max_new,
                 "temperature": t.temperature, "top_p": t.top_p,
+                "top_k": t.top_k,
+                "sampling_path": self.engine.sampling_path,
                 "eos_ids": list(t.eos_ids), "stop": list(t.stop),
                 "deadline_left": deadline_left,
                 "fed": s.fed, "produced": s.produced, "last": s.last,
@@ -751,6 +759,16 @@ class SlotScheduler:
                 "record is from a replica with incompatible geometry",
                 expected=want, got=meta["fingerprint"])
         extra = dict(meta.get("extra", {}))
+        rec_sp = extra.get("sampling_path")
+        if rec_sp is not None and rec_sp != eng.sampling_path:
+            # the record's sampled stream was drawn by a different
+            # sampling implementation — resuming here would silently
+            # change the distribution (absent flag = legacy record,
+            # accepted for compatibility)
+            raise snapfmt.SnapshotMismatch(
+                "<handoff record>", "sampling_path",
+                "record sampled on a different sampling path",
+                expected=eng.sampling_path, got=str(rec_sp))
         prompt = [int(x) for x in extra.get("prompt") or []]
         completion = [int(x) for x in extra.get("completion") or []]
         pos = int(meta["pos"])
@@ -819,12 +837,13 @@ class SlotScheduler:
                     eng.write_pool_pages(pages[:n_data], page_arrays)
                 if not others and not self._queue and "rng_key" in arrays:
                     eng.set_rng(arrays["rng_key"],
-                                int(meta["chunk_counter"]))
+                                int(meta["chunk_counter"]),
+                                dev_key_np=arrays.get("rng_dev_key"))
             t = Ticket(prompt, max_new,
                        float(extra.get("temperature", 0.0)),
                        float(extra.get("top_p", 0.9)),
                        tuple(int(e) for e in extra.get("eos_ids") or ()),
-                       deadline)
+                       deadline, top_k=int(extra.get("top_k", 0)))
             t.rid = str(extra.get("rid") or t.rid)
             # re-establish the fleet trace context on the importing
             # replica: every span this scheduler records for the resumed
@@ -1258,7 +1277,8 @@ class SlotScheduler:
                                      {"pages.k": arrays["pages.k"],
                                       "pages.v": arrays["pages.v"]})
             if not others and not self._queue and "rng_key" in arrays:
-                eng.set_rng(arrays["rng_key"], int(meta["chunk_counter"]))
+                eng.set_rng(arrays["rng_key"], int(meta["chunk_counter"]),
+                            dev_key_np=arrays.get("rng_dev_key"))
         s = self.slots[slot_idx]
         s.ticket = t
         s.pages = pages
@@ -1713,11 +1733,13 @@ class SlotScheduler:
         pos_rows = np.zeros((b,), np.int32)
         temps = np.zeros((b,), np.float32)
         topps = np.full((b,), 0.9, np.float32)
+        topks = np.zeros((b,), np.int32)
         for i in active:
             s = slots[i]
             pos_rows[i] = s.pos
             temps[i] = s.ticket.temperature
             topps[i] = s.ticket.top_p
+            topks[i] = s.ticket.top_k
             if s.fed < len(s.ticket.prompt):
                 c = min(t_width, len(s.ticket.prompt) - s.fed)
                 tokens[i, :c] = s.ticket.prompt[s.fed:s.fed + c]
@@ -1757,13 +1779,13 @@ class SlotScheduler:
                 if props:
                     handle = eng.slot_verify_async(
                         tokens, pos_rows, n_valid, temps_np=temps,
-                        topps_np=topps,
+                        topps_np=topps, topks_np=topks,
                         page_tables_np=self._page_tables
                         if self.paged else None)
                 else:
                     handle = eng.slot_step_async(
                         tokens, pos_rows, n_valid, temps_np=temps,
-                        topps_np=topps, steps=steps,
+                        topps_np=topps, topks_np=topks, steps=steps,
                         page_tables_np=self._page_tables
                         if self.paged else None)
         except Exception as e:
@@ -1774,6 +1796,7 @@ class SlotScheduler:
         return _Pending(handle=handle, error=error, active=list(active),
                         tickets=tickets, steps=steps, t_width=t_width,
                         n_valid=n_valid, temps=temps, topps=topps,
+                        topks=topks,
                         prefset=prefset, rid_by_slot=rid_by_slot,
                         fed_by_slot=fed_by_slot, pos_rows=pos_rows,
                         enq_tp=tp0, t0_mono=time.monotonic(),
@@ -1867,7 +1890,8 @@ class SlotScheduler:
             with self._engine_lock:
                 handle = eng.slot_step_async(
                     None, pos2, np.ones((b,), np.int32),
-                    temps_np=cur.temps, topps_np=cur.topps, steps=steps2,
+                    temps_np=cur.temps, topps_np=cur.topps,
+                    topks_np=cur.topks, steps=steps2,
                     page_tables_np=ptab, feed_dev=cur.handle.last_dev)
         except Exception as e:
             err = e
@@ -1884,7 +1908,8 @@ class SlotScheduler:
                         active=list(cur.active), tickets=dict(cur.tickets),
                         steps=steps2, t_width=1,
                         n_valid=np.ones((b,), np.int32),
-                        temps=cur.temps, topps=cur.topps, prefset=set(),
+                        temps=cur.temps, topps=cur.topps, topks=cur.topks,
+                        prefset=set(),
                         rid_by_slot=dict(cur.rid_by_slot), fed_by_slot={},
                         pos_rows=pos2, enq_tp=time.perf_counter(),
                         t0_mono=time.monotonic(), host_gap_ms=0.0,

@@ -68,11 +68,95 @@ def sample_topp(probs: np.ndarray, topp: float, coin: float) -> int:
     return int(order[min(pick, last)])
 
 
+def apply_topk(logits: np.ndarray, topk: int) -> np.ndarray:
+    """Keep the ``topk`` largest logits (ties at the bar all survive),
+    -inf the rest.  0 (or >= n) disables.  Threshold rule (k-th largest
+    value, keep ``>=``) matches the device mirror exactly so fixed-coin
+    parity holds through ties."""
+    n = len(logits)
+    if topk <= 0 or topk >= n:
+        return logits
+    thresh = np.partition(logits, n - topk)[n - topk]
+    return np.where(logits < thresh, -np.inf, logits)
+
+
+def sample_with_coin(logits: np.ndarray, coin: float, *, temperature: float,
+                     topp: float, topk: int = 0,
+                     mask: np.ndarray | None = None) -> int:
+    """One sampling decision from an explicit uniform ``coin`` — the host
+    reference the device path (:func:`sample_on_device`) mirrors
+    branch-for-branch: vocab mask → top-k filter → temperature →
+    (greedy | nucleus | plain multinomial).  ``mask`` is an optional
+    boolean keep-vector (the grammar seam — identity today)."""
+    logits = np.asarray(logits, dtype=np.float32).reshape(-1)
+    if mask is not None:
+        logits = np.where(np.asarray(mask, dtype=bool).reshape(-1),
+                          logits, -np.inf)
+    logits = apply_topk(logits, int(topk))
+    if temperature == 0.0:
+        return int(np.argmax(logits))
+    probs = softmax(logits / temperature)
+    if topp <= 0 or topp >= 1:
+        return sample_mult(probs, coin)
+    return sample_topp(probs, topp, coin)
+
+
+def sample_on_device(logits, coins, temps, topps, topks, mask=None):
+    """Jit-friendly batched mirror of :func:`sample_with_coin`.
+
+    ``logits`` (B, V) stay on device; ``coins``/``temps``/``topps``/
+    ``topks`` are (B,) per-row parameters and ``mask`` an optional
+    (V,)- or (B, V)-broadcastable boolean keep-mask.  Returns (B,) int32
+    token ids.  Every branch reproduces the host reference's decision
+    rule on the same f32 probabilities — descending ``top_k`` breaks
+    ties by lower index exactly like the host's stable sort, the
+    nucleus prefix/cutoff/renormalized-CDF walk follows
+    tokenizer.cpp:328-369 — so a fixed coin picks the same token on
+    both paths (the distribution-parity test contract)."""
+    import jax
+    import jax.numpy as jnp
+
+    lf = logits.astype(jnp.float32)
+    v = lf.shape[-1]
+    if mask is not None:
+        lf = jnp.where(jnp.asarray(mask).astype(bool), lf, -jnp.inf)
+
+    def row(lr, coin, temp, topp, topk):
+        # top-k: k-th largest value as threshold, ties at the bar survive
+        svals = jax.lax.top_k(lr, v)[0]
+        thresh = svals[jnp.clip(topk - 1, 0, v - 1)]
+        lr = jnp.where((topk > 0) & (lr < thresh), -jnp.inf, lr)
+        greedy_tok = jnp.argmax(lr).astype(jnp.int32)
+        probs = jax.nn.softmax(lr / jnp.where(temp > 0.0, temp, 1.0))
+        # plain multinomial: CDF walk = searchsorted(cdf, coin, "right")
+        cdf = jnp.cumsum(probs)
+        mult_tok = jnp.clip(jnp.sum(cdf <= coin), 0, v - 1).astype(jnp.int32)
+        # nucleus: descending probs put every p >= cutoff in a prefix
+        sp, si = jax.lax.top_k(probs, v)
+        cutoff = (1.0 - topp) / (v - 1)
+        cand = sp >= cutoff
+        ncand = jnp.sum(cand)
+        cum = jnp.cumsum(sp)
+        over = (cum > topp) & cand
+        last = jnp.where(jnp.any(over), jnp.argmax(over),
+                         jnp.maximum(ncand - 1, 0))
+        r = coin * cum[last]
+        pick = jnp.sum((cum <= r) & (jnp.arange(v) <= last))
+        topp_tok = si[jnp.minimum(pick, last)].astype(jnp.int32)
+        use_topp = (topp > 0.0) & (topp < 1.0) & (ncand > 0)
+        sampled = jnp.where(use_topp, topp_tok, mult_tok)
+        return jnp.where(temp == 0.0, greedy_tok, sampled)
+
+    return jax.vmap(row)(lf, coins, temps, topps, topks.astype(jnp.int32))
+
+
 class Sampler:
-    def __init__(self, vocab_size: int, temperature: float, topp: float, seed: int):
+    def __init__(self, vocab_size: int, temperature: float, topp: float,
+                 seed: int, topk: int = 0):
         self.vocab_size = vocab_size
         self.temperature = temperature
         self.topp = topp
+        self.topk = int(topk)
         self.rng_state = seed & 0xFFFFFFFFFFFFFFFF
 
     def set_temp(self, temperature: float):
@@ -81,12 +165,13 @@ class Sampler:
     def set_seed(self, seed: int):
         self.rng_state = seed & 0xFFFFFFFFFFFFFFFF
 
-    def sample(self, logits: np.ndarray) -> int:
+    def sample(self, logits: np.ndarray, mask: np.ndarray | None = None) -> int:
         logits = np.asarray(logits, dtype=np.float32).reshape(-1)[: self.vocab_size]
+        if mask is not None:
+            mask = np.asarray(mask, dtype=bool).reshape(-1)[: self.vocab_size]
         if self.temperature == 0.0:
-            return int(np.argmax(logits))
-        probs = softmax(logits / self.temperature)
+            return sample_with_coin(logits, 0.0, temperature=0.0,
+                                    topp=self.topp, topk=self.topk, mask=mask)
         self.rng_state, coin = xorshift_f32(self.rng_state)
-        if self.topp <= 0 or self.topp >= 1:
-            return sample_mult(probs, coin)
-        return sample_topp(probs, self.topp, coin)
+        return sample_with_coin(logits, coin, temperature=self.temperature,
+                                topp=self.topp, topk=self.topk, mask=mask)

@@ -443,6 +443,67 @@ def test_cli_inference_clean_summary(tmp_path):
     assert "DEGRADED" not in r.stdout
 
 
+# --- one-dispatch decode: steady pure-decode family count ----------------
+
+def test_steady_decode_dispatch_families(monkeypatch):
+    """The one-dispatch-decode contract (docs/PERF.md): the ledger
+    records once per compiled call site at trace time, so the distinct
+    matmul (``q40/``/``q8/``) + attention (``kv_``) families of one
+    steady pure-decode trace ARE the per-step device dispatch count.
+    Fused (interpret mode on CPU): ≤ 2 — one matmul family plus
+    ``paged-fused``.  Unfused gather arm: ≥ 3.  Sampled rows add
+    ``sample/sample-dev`` (on-device, excluded from the count)."""
+    import jax
+    from dllama_tpu.models.config import tiny_config
+    from dllama_tpu.models.params import init_params
+    from dllama_tpu.parallel.mesh import make_mesh
+    from dllama_tpu.runtime.engine import Engine
+
+    cfg = tiny_config(seq_len=64)
+    eng = Engine(cfg, init_params(cfg, seed=4),
+                 mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+                 batch=2, kv_pages=17, kv_page_size=8)
+    ptab = np.asarray([[1, 2], [3, 4]], np.int32)
+
+    def trace_families(mode, greedy):
+        # each (mode, greedy) pair is a fresh engine compile key, so the
+        # slot_step below traces (and records) rather than hitting cache
+        monkeypatch.setenv("DLLAMA_FUSED_ATTN", mode)
+        obs_dispatch.reset()
+        temps = np.zeros(2, np.float32) if greedy \
+            else np.full(2, 0.8, np.float32)
+        eng.slot_step(np.ones((2, 1), np.int32),
+                      np.asarray([9, 9], np.int32), np.ones(2, np.int32),
+                      temps_np=temps,
+                      topps_np=np.full(2, 0.9, np.float32),
+                      page_tables_np=ptab)
+        d = obs_dispatch.dispatches()
+        return {k for k in d if k.startswith(("q40/", "q8/", "kv_"))}, d
+
+    fused, d = trace_families("interp", greedy=True)
+    assert len(fused) <= 2, f"fused steady decode traced {sorted(fused)}"
+    attn_fused = {k for k in fused if k.startswith("kv_")}
+    assert attn_fused == {"kv_dense/paged-fused"}
+    assert "sample/sample-dev" not in d  # greedy consumes no coin
+
+    # the weight-matmul family records inside q40's own dispatch site, so
+    # it may already be warm in this process — the attention side is what
+    # the fused kernel collapses: 1 family vs the gather arm's 2 (3 for
+    # int8 pools, whose dequant rides a third record).  1 matmul + these
+    # is the ≤2-vs-≥3 per-step contract docs/PERF.md states; bench stage
+    # cpu-tiny-fused4 measures it cold-process.
+    unfused, _ = trace_families("off", greedy=True)
+    attn_unfused = {k for k in unfused if k.startswith("kv_")}
+    assert attn_unfused == {"kv_dense/paged-gather", "kv_dense/attn-score"}
+
+    sampled, d = trace_families("interp", greedy=False)
+    assert {k for k in sampled if k.startswith("kv_")} == \
+        {"kv_dense/paged-fused"}
+    assert len(sampled) <= 2
+    assert d.get("sample/sample-dev", 0) >= 1  # sampling stayed on device
+    assert obs_dispatch.degraded() is False  # interp is a mode, not a degrade
+
+
 # --- satellite: fast tier keeps its non-trivial core ----------------------
 
 def test_fast_tier_collects_core_suites():

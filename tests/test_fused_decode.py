@@ -1,0 +1,363 @@
+"""One-dispatch decode tests (docs/PERF.md "one-dispatch decode"): the
+fused page-walk paged-attention Pallas kernel (ops/attention.py
+``fused_paged_attention``) and the on-device sampling stage
+(sampling.sample_on_device + the engine's device-resident RNG key
+chain).
+
+Contracts pinned here on CPU — the kernel runs in Pallas interpret mode
+(``DLLAMA_FUSED_ATTN=interp``: same kernel logic, no TPU needed):
+
+* **kernel parity** — the fused kernel matches the gather +
+  rows-ceiling reference on a random ragged fixture, dense and int8
+  pools, at a non-zero layer (tolerance scaled to the reference
+  magnitude: the two implementations associate the bf16 online-softmax
+  folds differently, so 2e-5 elementwise is the wrong bar);
+* **byte parity** — greedy decode through the paged scheduler is
+  token-identical with the kernel forced on vs off, overlap on and
+  off, dense and int8 pools (the fused kernel is a dispatch-structure
+  change, never a numerics change at argmax granularity);
+* **fixed-coin parity** — ``sample_on_device`` picks the same token as
+  the host ``sample_with_coin`` for the same coin across a
+  temperature × top-p × top-k × mask grid including ties;
+* **device key chain** — sampled slot decode is deterministic given
+  the engine seed, a snapshot/restore continues the sampled stream
+  byte-identically (the device key rides DLSNAP02), hand-off records
+  carry the device key + sampling-path flag, and a record from a
+  different sampling path is refused before any state is touched.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dllama_tpu.models.config import tiny_config
+from dllama_tpu.models.params import init_params
+from dllama_tpu.ops.attention import (_rows_ceiling_attention,
+                                      fused_paged_attention,
+                                      paged_gather_layer, quantize_kv)
+from dllama_tpu.parallel.mesh import make_mesh
+from dllama_tpu.runtime import snapshot as snapfmt
+from dllama_tpu.runtime.engine import Engine
+from dllama_tpu.runtime.faults import injected
+from dllama_tpu.runtime.scheduler import SlotScheduler
+from dllama_tpu.sampling import sample_on_device, sample_with_coin
+
+CFG = tiny_config(seq_len=64)
+PAGE = 8
+PROMPTS = ([5, 9, 2], [7, 3, 11, 4, 6, 1, 8], [2, 4, 6], [9, 8, 7, 6])
+
+
+def make_paged_engine(batch=4, page=PAGE, **kw):
+    pages_per_slot = -(-CFG.seq_len // page)
+    return Engine(CFG, init_params(CFG, seed=4),
+                  mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+                  batch=batch,
+                  kv_pages=batch * pages_per_slot + 1,
+                  kv_page_size=page, **kw)
+
+
+# -- kernel vs gather reference --------------------------------------------
+
+def _pool_fixture(quantized, b=3, maxp=3, hkv=2, g=2, ps=8, dh=16,
+                  nlayers=2):
+    npages = 1 + b * maxp
+    rng = np.random.RandomState(3)
+    table = jnp.asarray(np.arange(1, 1 + b * maxp).reshape(b, maxp),
+                        jnp.int32)
+    # ragged: one full row, one mid-page, one inside the first page
+    pos_rows = jnp.asarray([maxp * ps - 1, ps + ps // 2, 3], jnp.int32)
+    q = jnp.asarray(rng.randn(b, hkv * g, 1, dh) * 0.3, jnp.float32)
+    if quantized:
+        pk, sk = quantize_kv(jnp.asarray(
+            rng.randn(nlayers, npages, hkv, ps, dh), jnp.float32))
+        pv, sv = quantize_kv(jnp.asarray(
+            rng.randn(nlayers, npages, hkv, ps, dh), jnp.float32))
+        scales = (sk, sv)
+    else:
+        pk = jnp.asarray(rng.randn(nlayers, npages, hkv, ps, dh) * 0.3,
+                         jnp.bfloat16)
+        pv = jnp.asarray(rng.randn(nlayers, npages, hkv, ps, dh) * 0.3,
+                         jnp.bfloat16)
+        scales = None
+    return q, pk, pv, table, pos_rows, scales
+
+
+@pytest.mark.parametrize("quantized", [False, True],
+                         ids=["dense", "kv_int8"])
+def test_fused_kernel_matches_gather_reference(quantized):
+    """The page-walk kernel and the materialized-gather path compute the
+    same attention read — ragged rows, layer 1 of 2 (the layer index
+    rides scalar prefetch), dead pages fully masked."""
+    q, pk, pv, table, pos_rows, scales = _pool_fixture(quantized)
+    layer = jnp.int32(1)
+    out = fused_paged_attention(q, pk, pv, layer, table, pos_rows,
+                                scales=scales, interpret=True)
+    ks, vs = scales if scales is not None else (None, None)
+    k_l = paged_gather_layer(pk, layer, table, scale_pool=ks)
+    v_l = paged_gather_layer(pv, layer, table, scale_pool=vs)
+    ref = _rows_ceiling_attention(q, k_l, v_l, pos_rows)
+    assert out.shape == ref.shape == q.shape
+    tol = 1e-2 * max(float(np.abs(np.asarray(ref, np.float32)).max()), 1e-3)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=tol)
+
+
+def test_fused_kernel_under_jit():
+    """The kernel composes with jit (the engine always calls it inside a
+    compiled step) and stays deterministic across calls."""
+    q, pk, pv, table, pos_rows, scales = _pool_fixture(False)
+
+    @jax.jit
+    def step(q):
+        return fused_paged_attention(q, pk, pv, jnp.int32(0), table,
+                                     pos_rows, interpret=True)
+
+    a = np.asarray(step(q))
+    b = np.asarray(step(q))
+    np.testing.assert_array_equal(a, b)
+    ref = _rows_ceiling_attention(
+        q, paged_gather_layer(pk, jnp.int32(0), table),
+        paged_gather_layer(pv, jnp.int32(0), table), pos_rows)
+    tol = 1e-2 * max(float(np.abs(np.asarray(ref, np.float32)).max()), 1e-3)
+    np.testing.assert_allclose(a.astype(np.float32),
+                               np.asarray(ref, np.float32), atol=tol)
+
+
+# -- e2e greedy byte parity: fused vs fallback -----------------------------
+
+def _sched_streams(overlap, kv_dtype, max_new=20):
+    eng = make_paged_engine(**({"kv_dtype": kv_dtype} if kv_dtype else {}))
+    sched = SlotScheduler(eng, prefill_chunk=8, max_wait_ms=20.0,
+                          overlap=overlap)
+    out = [None] * len(PROMPTS)
+
+    def go(i):
+        t = sched.submit(list(PROMPTS[i]), max_new)
+        out[i] = list(t.tokens())
+
+    ths = [threading.Thread(target=go, args=(i,))
+           for i in range(len(PROMPTS))]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join()
+    sched.close()
+    assert all(len(s) == max_new for s in out)
+    return out
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "q8"], ids=["dense", "kv_int8"])
+@pytest.mark.parametrize("overlap", [False, True],
+                         ids=["sync", "overlap"])
+def test_greedy_byte_parity_fused_vs_fallback(monkeypatch, overlap,
+                                              kv_dtype):
+    """Ragged staggered greedy decode through the paged scheduler: the
+    emitted streams with the fused kernel forced on (interpret mode)
+    must be byte-identical to the gather fallback, overlap on and off,
+    dense and int8 pools."""
+    monkeypatch.setenv("DLLAMA_FUSED_ATTN", "interp")
+    fused = _sched_streams(overlap, kv_dtype)
+    monkeypatch.setenv("DLLAMA_FUSED_ATTN", "off")
+    fallback = _sched_streams(overlap, kv_dtype)
+    assert fused == fallback
+
+
+# -- fixed-coin sampling parity host vs device -----------------------------
+
+def test_fixed_coin_sampling_parity():
+    """For the same uniform coin, sample_on_device picks the same token
+    as the host sample_with_coin across the sampling-mode grid —
+    greedy, plain multinomial, nucleus, top-k (with ties at the bar),
+    and the optional vocab keep-mask."""
+    rng = np.random.RandomState(11)
+    v = 48
+    cases = [(t, p, k) for t in (0.0, 0.4, 1.0)
+             for p in (0.0, 0.5, 0.9, 1.0)
+             for k in (0, 3, v)]
+    n = len(cases)
+    logits = (rng.randn(n, v) * 2.0).astype(np.float32)
+    logits[:, 7] = logits[:, 3]  # ties through top-k and the stable sort
+    coins = rng.rand(n).astype(np.float32)
+    temps = np.asarray([c[0] for c in cases], np.float32)
+    topps = np.asarray([c[1] for c in cases], np.float32)
+    topks = np.asarray([c[2] for c in cases], np.int32)
+    mask = np.ones(v, bool)
+    mask[::7] = False
+    for m in (None, mask):
+        host = [sample_with_coin(logits[i], float(coins[i]),
+                                 temperature=float(temps[i]),
+                                 topp=float(topps[i]), topk=int(topks[i]),
+                                 mask=m)
+                for i in range(n)]
+        dev = sample_on_device(
+            jnp.asarray(logits), jnp.asarray(coins), jnp.asarray(temps),
+            jnp.asarray(topps), jnp.asarray(topks),
+            mask=None if m is None else jnp.asarray(m))
+        assert [int(x) for x in np.asarray(dev)] == host, \
+            f"device/host divergence (mask={m is not None})"
+
+
+def test_identity_mask_is_identity():
+    """The all-True vocab mask (the grammar seam's identity) changes no
+    decision on either path."""
+    rng = np.random.RandomState(5)
+    v = 32
+    logits = (rng.randn(6, v) * 1.5).astype(np.float32)
+    coins = rng.rand(6).astype(np.float32)
+    temps = np.full(6, 0.8, np.float32)
+    topps = np.full(6, 0.9, np.float32)
+    topks = np.zeros(6, np.int32)
+    ident = np.ones(v, bool)
+    no_mask = sample_on_device(jnp.asarray(logits), jnp.asarray(coins),
+                               jnp.asarray(temps), jnp.asarray(topps),
+                               jnp.asarray(topks))
+    with_mask = sample_on_device(jnp.asarray(logits), jnp.asarray(coins),
+                                 jnp.asarray(temps), jnp.asarray(topps),
+                                 jnp.asarray(topks), mask=jnp.asarray(ident))
+    np.testing.assert_array_equal(np.asarray(no_mask), np.asarray(with_mask))
+    for i in range(6):
+        assert sample_with_coin(
+            logits[i], float(coins[i]), temperature=0.8, topp=0.9,
+            mask=ident) == int(np.asarray(no_mask)[i])
+
+
+# -- device RNG key chain: determinism, snapshot, hand-off -----------------
+
+def _sampled_decode(eng, n_steps, b=2):
+    """Prefill PROMPTS[:b] rows, then ``n_steps`` sampled pure-decode
+    slot_steps feeding each row its own previous sample.  Returns the
+    (n_steps, b) emitted ids plus the loop state for continuation."""
+    ps = PAGE
+    maxp = -(-CFG.seq_len // ps)
+    ptab = np.asarray(
+        1 + np.arange(b * maxp).reshape(b, maxp), np.int32)
+    temps = np.full(b, 0.8, np.float32)
+    topps = np.full(b, 0.9, np.float32)
+    width = max(len(p) for p in PROMPTS[:b])
+    toks = np.zeros((b, width), np.int32)
+    n_valid = np.zeros(b, np.int32)
+    for i, p in enumerate(PROMPTS[:b]):
+        toks[i, :len(p)] = p
+        n_valid[i] = len(p)
+    pos = np.zeros(b, np.int32)
+    first = eng.slot_step(toks, pos, n_valid, temps_np=temps,
+                          topps_np=topps, page_tables_np=ptab)
+    pos = pos + n_valid
+    cur = first[-1]
+    out = [cur.copy()]
+    for _ in range(n_steps - 1):
+        t = eng.slot_step(cur[:, None].astype(np.int32), pos,
+                          np.ones(b, np.int32), temps_np=temps,
+                          topps_np=topps, page_tables_np=ptab)
+        pos = pos + 1
+        cur = t[-1]
+        out.append(cur.copy())
+    return np.stack(out), (cur, pos, ptab, temps, topps)
+
+
+def _continue_decode(eng, state, n_steps):
+    cur, pos, ptab, temps, topps = state
+    b = len(cur)
+    out = []
+    for _ in range(n_steps):
+        t = eng.slot_step(cur[:, None].astype(np.int32), pos,
+                          np.ones(b, np.int32), temps_np=temps,
+                          topps_np=topps, page_tables_np=ptab)
+        pos = pos + 1
+        cur = t[-1]
+        out.append(cur.copy())
+    return np.stack(out), (cur, pos, ptab, temps, topps)
+
+
+def test_sampled_decode_deterministic_across_engines():
+    """Two engines built from the same seed thread the same device key
+    chain: sampled slot decode emits identical streams — the property
+    that makes on-device sampling snapshot/hand-off safe at all."""
+    a, _ = _sampled_decode(make_paged_engine(batch=2), 8)
+    b, _ = _sampled_decode(make_paged_engine(batch=2), 8)
+    np.testing.assert_array_equal(a, b)
+    assert len(np.unique(a)) > 1  # actually sampling, not a constant
+
+
+def test_sampled_stream_survives_snapshot_restore(tmp_path):
+    """DLSNAP02 carries the device RNG key beside the host stream: a
+    restored engine continues the sampled stream byte-identically to
+    the uninterrupted run."""
+    eng = make_paged_engine(batch=2)
+    head, state = _sampled_decode(eng, 4)
+    path = tmp_path / "mid.dlsnap"
+    eng.snapshot(path)
+    tail_uninterrupted, _ = _continue_decode(eng, state, 5)
+
+    eng2 = make_paged_engine(batch=2)
+    eng2.restore(path)
+    tail_restored, _ = _continue_decode(eng2, state, 5)
+    np.testing.assert_array_equal(tail_uninterrupted, tail_restored)
+
+
+def test_snapshot_sampling_path_mismatch_rejected(tmp_path, monkeypatch):
+    """A snapshot taken on the device sampling path names it in the
+    meta; an engine pinned to the host path refuses the restore with
+    SnapshotMismatch instead of silently switching coin streams."""
+    eng = make_paged_engine(batch=2)
+    assert eng.sampling_path == "device"
+    _sampled_decode(eng, 2)
+    path = tmp_path / "dev.dlsnap"
+    eng.snapshot(path)
+
+    monkeypatch.setenv("DLLAMA_SAMPLING_PATH", "host")
+    eng2 = make_paged_engine(batch=2)
+    assert eng2.sampling_path == "host"
+    with pytest.raises(snapfmt.SnapshotMismatch, match="sampling_path"):
+        eng2.restore(path)
+
+    monkeypatch.setenv("DLLAMA_SAMPLING_PATH", "device")
+    eng3 = make_paged_engine(batch=2)
+    eng3.restore(path)  # matching path restores fine
+
+
+def test_handoff_record_carries_dev_key_and_rejects_mismatch(monkeypatch):
+    """DLREQ01 hand-off records export the device RNG key and the
+    engine's sampling-path flag; an importer on a different sampling
+    path refuses the record before touching any state."""
+    monkeypatch.delenv("DLLAMA_SAMPLING_PATH", raising=False)
+    sa = SlotScheduler(make_paged_engine(batch=2), prefill_chunk=4,
+                       max_wait_ms=20.0, decode_burst=4)
+    try:
+        with injected("engine.device_step=delay:0.05"):
+            t = sa.submit(list(PROMPTS[0]), 30, temperature=0.7)
+            it = t.tokens()
+            for _ in range(4):
+                next(it)
+            records = sa.handoff_export_all()
+        list(it)
+    finally:
+        sa.close()
+    assert set(records) == {t.rid}
+    meta, arrays = snapfmt.loads_request(records[t.rid])
+    assert meta["extra"]["sampling_path"] == "device"
+    assert "rng_dev_key" in arrays  # the sampled chunk seeded the chain
+
+    monkeypatch.setenv("DLLAMA_SAMPLING_PATH", "host")
+    sb = SlotScheduler(make_paged_engine(batch=2), prefill_chunk=4,
+                       max_wait_ms=20.0)
+    try:
+        with pytest.raises(snapfmt.SnapshotMismatch, match="sampling_path"):
+            sb.import_request(records[t.rid])
+    finally:
+        sb.close()
+
+    monkeypatch.setenv("DLLAMA_SAMPLING_PATH", "device")
+    sc = SlotScheduler(make_paged_engine(batch=2), prefill_chunk=4,
+                       max_wait_ms=20.0)
+    try:
+        t2, extra = sc.import_request(records[t.rid])
+        assert extra["sampling_path"] == "device"
+        resumed = list(t2.tokens())
+        assert len(meta["extra"]["completion"]) + len(resumed) == 30
+    finally:
+        sc.close()

@@ -45,7 +45,8 @@ DEFAULT_THRESHOLD = 0.10
 # utilization, acceptance).
 _LOWER_HINTS = ("_ms", "ms_", "host_gap", "gap_share", "share", "spill",
                 "queued", "burn", "wait", "latency", "ttft", "itl",
-                "recompile", "degrade", "errors", "preempt")
+                "recompile", "degrade", "errors", "preempt",
+                "dispatches_per_step")
 _HIGHER_HINTS = ("toks", "tok_s", "speedup", "goodput", "mfu", "mbu",
                  "accept", "ratio", "throughput", "served", "reused",
                  "hit", "value")
@@ -247,6 +248,14 @@ def self_check() -> int:
          ["verdict"] == "ok"),
         ("latency jump regresses",
          compare({"ttft_seconds_avg": 0.1}, {"ttft_seconds_avg": 0.2})
+         ["verdict"] == "regression"),
+        ("dispatch-count drop is improvement",
+         compare({"cpu_fused4_dispatches_per_step": 4.0},
+                 {"cpu_fused4_dispatches_per_step": 2.0})
+         ["verdict"] == "ok"),
+        ("dispatch-count jump regresses",
+         compare({"cpu_fused4_dispatches_per_step": 2.0},
+                 {"cpu_fused4_dispatches_per_step": 4.0})
          ["verdict"] == "regression"),
     ]
     stage = normalize_stage_lines([json.dumps(
